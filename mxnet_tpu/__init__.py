@@ -1,0 +1,15 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capability surface of
+MXNet 0.9.4 (NNVM era), redesigned for JAX/XLA/Pallas rather than ported.
+
+See SURVEY.md for the reference layer map this package mirrors and README.md for
+the architecture.
+"""
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context
+from . import base
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from . import ops
+
+__version__ = "0.1.0"

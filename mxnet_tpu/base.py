@@ -1,0 +1,78 @@
+"""Base utilities for the TPU-native MXNet rebuild.
+
+Replaces the reference's ctypes plumbing (reference: python/mxnet/base.py) and the
+dmlc-core slice (logging/CHECK, registry, env config).  There is no C-API marshalling
+layer here because the compute substrate is JAX/XLA reached directly from Python; the
+native runtime (engine / IO) is bound through :mod:`mxnet_tpu.lib` instead.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["MXNetError", "string_types", "numeric_types", "get_env", "check",
+           "Registry", "classproperty"]
+
+string_types = (str,)
+numeric_types = (float, int)
+
+
+class MXNetError(Exception):
+    """Error raised by mxnet_tpu (parity: reference python/mxnet/base.py:MXNetError)."""
+
+
+def check(cond, msg="check failed"):
+    """CHECK-style assertion (parity: dmlc-core CHECK macros)."""
+    if not cond:
+        raise MXNetError(msg)
+
+
+def get_env(name, default=None, typ=None):
+    """Read a runtime env var (parity: dmlc::GetEnv, docs/how_to/env_var.md)."""
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if typ is bool:
+        return val not in ("0", "false", "False", "")
+    if typ is not None:
+        return typ(val)
+    return val
+
+
+class Registry(object):
+    """Generic name->entry registry (parity: dmlc registry used for ops/iters/metrics)."""
+
+    def __init__(self, kind):
+        self.kind = kind
+        self._entries = {}
+        self._lock = threading.Lock()
+
+    def register(self, name, entry, override=False):
+        with self._lock:
+            if name in self._entries and not override:
+                raise MXNetError("%s '%s' already registered" % (self.kind, name))
+            self._entries[name] = entry
+        return entry
+
+    def get(self, name):
+        try:
+            return self._entries[name]
+        except KeyError:
+            raise MXNetError("unknown %s: %s" % (self.kind, name))
+
+    def find(self, name):
+        return self._entries.get(name)
+
+    def __contains__(self, name):
+        return name in self._entries
+
+    def list_names(self):
+        return sorted(self._entries)
+
+
+class classproperty(object):
+    def __init__(self, f):
+        self.f = f
+
+    def __get__(self, obj, owner):
+        return self.f(owner)

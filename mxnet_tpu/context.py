@@ -1,0 +1,117 @@
+"""Device context (parity: reference python/mxnet/context.py, include/mxnet/base.h:103-130).
+
+TPU-first design: a Context names a JAX device.  ``mx.tpu()`` is first-class; ``cpu``
+maps to the host platform.  ``gpu`` is accepted as an alias for the accelerator
+platform so that reference example scripts run unchanged on TPU.  Under the test
+harness (JAX_PLATFORMS=cpu with xla_force_host_platform_device_count=N) every
+``cpu(i)``/``tpu(i)`` resolves to one of the N virtual host devices, which is how
+multi-device semantics are tested without hardware.
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context"]
+
+_DEVTYPE2ID = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 4}
+_ID2DEVTYPE = {v: k for k, v in _DEVTYPE2ID.items()}
+
+
+class Context(object):
+    """A device context. ``Context('tpu', 0)`` or via helpers ``mx.tpu(0)``."""
+
+    _default_ctx = threading.local()
+    devtype2str = _ID2DEVTYPE
+    devstr2type = _DEVTYPE2ID
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_type, self.device_id = device_type.device_type, device_type.device_id
+        else:
+            if device_type not in _DEVTYPE2ID:
+                raise MXNetError("unknown device type %s" % device_type)
+            self.device_type = device_type
+            self.device_id = device_id
+        self._old_ctx = None
+
+    @property
+    def device_typeid(self):
+        return _DEVTYPE2ID[self.device_type]
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    def __str__(self):
+        return self.__repr__()
+
+    def __enter__(self):
+        self._old_ctx = getattr(Context._default_ctx, "value", None)
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        Context._default_ctx.value = self._old_ctx
+
+    # -- JAX mapping ------------------------------------------------------
+    def jax_device(self):
+        """Resolve this context to a concrete jax.Device."""
+        import jax
+
+        plat_order = {
+            "cpu": ("cpu",),
+            "cpu_pinned": ("cpu",),
+            # gpu/tpu both mean "the accelerator platform"; fall back to host
+            # so reference scripts written for gpu run under the CPU test harness.
+            "gpu": (None, "cpu"),
+            "tpu": (None, "cpu"),
+        }[self.device_type]
+        for plat in plat_order:
+            try:
+                devs = jax.devices(plat) if plat else jax.devices()
+                if plat is None and devs and devs[0].platform == "cpu" \
+                        and self.device_type in ("gpu", "tpu"):
+                    # default backend is host: treat virtual host devices as chips
+                    pass
+                if self.device_id < len(devs):
+                    return devs[self.device_id]
+            except RuntimeError:
+                continue
+        raise MXNetError("no device for context %r" % self)
+
+
+def cpu(device_id=0):
+    """Return a CPU context (parity: mx.cpu)."""
+    return Context("cpu", device_id)
+
+
+def gpu(device_id=0):
+    """Accelerator alias (parity: mx.gpu); resolves to the TPU/accelerator platform."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0):
+    """First-class TPU context (north star: BASELINE.json mx.tpu())."""
+    return Context("tpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context("cpu_pinned", device_id)
+
+
+def current_context():
+    """The active default context (parity: mx.current_context)."""
+    ctx = getattr(Context._default_ctx, "value", None)
+    return ctx if ctx is not None else Context("cpu", 0)
+
+
+Context.default_ctx = property(lambda self: current_context())

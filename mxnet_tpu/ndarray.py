@@ -1,0 +1,598 @@
+"""NDArray — the imperative tensor (parity: reference python/mxnet/ndarray.py,
+include/mxnet/ndarray.h, src/ndarray/ndarray.cc).
+
+TPU-first design: an NDArray owns a ``jax.Array`` living in device memory (HBM for
+``mx.tpu()``).  Every imperative op dispatches through the jit cache in
+``ops.registry`` — JAX's async dispatch gives the same "returns immediately,
+engine-ordered" behaviour as the reference's dependency engine, with XLA owning
+scheduling.  Mutation (``x[:] = v``, ``+=``) rebinds the underlying buffer; *views*
+(``x[1:3]``, ``reshape``) record a transform chain against their root array and
+write through it functionally (``.at[].set``) — this reproduces the reference's
+aliased Slice/Reshape/At views (ndarray.h:239-280) without mutable aliasing, which
+XLA cannot express.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, current_context
+from .ops import registry as _reg
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "concatenate", "load", "save", "imdecode", "onehot_encode", "waitall"]
+
+_pyslice = slice  # the builtin; the module also exports an op named `slice`
+
+_DTYPE_CODE = {np.dtype("float32"): 0, np.dtype("float64"): 1,
+               np.dtype("float16"): 2, np.dtype("uint8"): 3,
+               np.dtype("int32"): 4, np.dtype("int8"): 5, np.dtype("int64"): 6}
+_CODE_DTYPE = {v: k for k, v in _DTYPE_CODE.items()}
+_BF16_CODE = 100
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _platform_devtype(dev):
+    return "cpu" if dev.platform == "cpu" else "tpu"
+
+
+class NDArray(object):
+    """Multi-dimensional array on a device (parity: mx.nd.NDArray)."""
+
+    __slots__ = ("_data", "_base", "_chain", "_ctx", "writable")
+
+    def __init__(self, data=None, ctx=None, base=None, chain=(), writable=True):
+        self._data = data          # jax.Array when root, else None
+        self._base = base          # root NDArray when view
+        self._chain = tuple(chain)  # view transforms applied to base value
+        self._ctx = ctx
+        self.writable = writable
+
+    # ----------------------------------------------------------- value access
+    @property
+    def value(self):
+        """The current jax.Array (reads through views)."""
+        if self._base is None:
+            return self._data
+        v = self._base.value
+        for t in self._chain:
+            v = _apply_view(v, t)
+        return v
+
+    def _set_value(self, arr):
+        """Rebind contents (writes through views to the root buffer)."""
+        if not self.writable:
+            raise MXNetError("trying to write to a read-only NDArray")
+        if self._base is None:
+            self._data = arr
+        else:
+            root = self._base
+            root._data = _write_through(root.value, self._chain, arr)
+
+    # -------------------------------------------------------------- properties
+    @property
+    def shape(self):
+        if self._base is None:
+            return tuple(self._data.shape)
+        return tuple(self.value.shape) if self._chain else tuple(self._base.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def dtype(self):
+        v = self.value
+        try:
+            return np.dtype(v.dtype)
+        except TypeError:
+            return v.dtype  # bfloat16
+
+    @property
+    def context(self):
+        if self._ctx is not None:
+            return self._ctx
+        if self._base is not None:
+            return self._base.context
+        devs = list(self._data.devices()) if hasattr(self._data, "devices") else []
+        if devs:
+            d = devs[0]
+            return Context(_platform_devtype(d), d.id)
+        return current_context()
+
+    @property
+    def T(self):
+        return transpose(self)
+
+    # ------------------------------------------------------------ conversions
+    def asnumpy(self):
+        """Blocking copy to host numpy (parity: WaitToRead + SyncCopyToCPU)."""
+        return np.asarray(self.value)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("the current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def astype(self, dtype):
+        return _invoke1("Cast", [self], {"dtype": dtype}, self.context)
+
+    def copy(self):
+        return _invoke1("_copy", [self], {}, self.context)
+
+    def copyto(self, other):
+        """Copy into another NDArray or to a Context (parity: CopyFromTo,
+        src/ndarray/ndarray.cc:234)."""
+        import jax
+        if isinstance(other, NDArray):
+            other._set_value(_jnp().asarray(self.value, other.dtype)
+                             if other.dtype != self.dtype else self.value + 0)
+            return other
+        if isinstance(other, Context):
+            arr = jax.device_put(self.value, other.jax_device())
+            return NDArray(arr, ctx=other)
+        raise MXNetError("copyto does not support type %s" % type(other))
+
+    def as_in_context(self, context):
+        if context == self.context:
+            return self
+        return self.copyto(context)
+
+    def wait_to_read(self):
+        import jax
+        jax.block_until_ready(self.value)
+
+    # ------------------------------------------------------------------ views
+    def reshape(self, shape):
+        """Memory-sharing reshape view (parity: MXNDArrayReshape)."""
+        from .ops.matrix import infer_reshape
+        new_shape = infer_reshape(self.shape, tuple(shape))
+        if self._base is None:
+            return NDArray(base=self, chain=(("reshape", new_shape),),
+                           ctx=self._ctx, writable=self.writable)
+        return NDArray(base=self._base,
+                       chain=self._chain + (("reshape", new_shape),),
+                       ctx=self._ctx, writable=self.writable)
+
+    def _make_view(self, t):
+        base = self if self._base is None else self._base
+        chain = (t,) if self._base is None else self._chain + (t,)
+        return NDArray(base=base, chain=chain, ctx=self._ctx,
+                       writable=self.writable)
+
+    def _slice(self, start, stop):
+        start = 0 if start is None else int(start)
+        stop = self.shape[0] if stop is None else int(stop)
+        return self._make_view(("slice", start, stop))
+
+    def _at(self, idx):
+        return self._make_view(("at", int(idx)))
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            if key >= self.shape[0]:
+                raise IndexError("index out of range")
+            return self._at(key)
+        if isinstance(key, _pyslice):
+            if key.step is not None and key.step != 1:
+                raise MXNetError("slice step is not supported")
+            return self._slice(key.start, key.stop)
+        raise MXNetError("NDArray only supports int/slice indexing for reads")
+
+    def __setitem__(self, key, value):
+        if not self.writable:
+            raise MXNetError("NDArray is not writable")
+        jnp = _jnp()
+        if isinstance(value, NDArray):
+            value = value.value
+        elif isinstance(value, (np.ndarray, list, int, float, np.generic)):
+            value = jnp.asarray(value, dtype=self.dtype)
+        if isinstance(key, _pyslice) and key.start is None and key.stop is None:
+            if hasattr(value, "shape") and tuple(value.shape) == self.shape:
+                self._set_value(jnp.asarray(value, self.dtype))
+            else:
+                self._set_value(jnp.broadcast_to(
+                    jnp.asarray(value, self.dtype), self.shape) + 0)
+            return
+        cur = self.value
+        self._set_value(cur.at[key].set(value))
+
+    # ------------------------------------------------------------- arithmetic
+    def __add__(self, other):
+        return _binary("_plus", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __iadd__(self, other):
+        out = self.__add__(other)
+        self._set_value(out.value)
+        return self
+
+    def __sub__(self, other):
+        return _binary("_minus", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        return _scalar("_rminus_scalar", self, other)
+
+    def __isub__(self, other):
+        self._set_value(self.__sub__(other).value)
+        return self
+
+    def __mul__(self, other):
+        return _binary("_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __imul__(self, other):
+        self._set_value(self.__mul__(other).value)
+        return self
+
+    def __div__(self, other):
+        return _binary("_div", "_div_scalar", self, other)
+
+    __truediv__ = __div__
+
+    def __rdiv__(self, other):
+        return _scalar("_rdiv_scalar", self, other)
+
+    __rtruediv__ = __rdiv__
+
+    def __idiv__(self, other):
+        self._set_value(self.__div__(other).value)
+        return self
+
+    __itruediv__ = __idiv__
+
+    def __pow__(self, other):
+        return _binary("_power", "_power_scalar", self, other)
+
+    def __rpow__(self, other):
+        return _scalar("_rpower_scalar", self, other)
+
+    def __neg__(self):
+        return _invoke1("negative", [self], {}, self.context)
+
+    def __eq__(self, other):
+        return _binary("_equal", "_equal_scalar", self, other)
+
+    def __ne__(self, other):
+        return _binary("_not_equal", "_not_equal_scalar", self, other)
+
+    def __gt__(self, other):
+        return _binary("_greater", "_greater_scalar", self, other)
+
+    def __ge__(self, other):
+        return _binary("_greater_equal", "_greater_equal_scalar", self, other)
+
+    def __lt__(self, other):
+        return _binary("_lesser", "_lesser_scalar", self, other)
+
+    def __le__(self, other):
+        return _binary("_lesser_equal", "_lesser_equal_scalar", self, other)
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        raise MXNetError("The truth value of an NDArray is ambiguous; "
+                         "use asscalar()")
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __repr__(self):
+        return "<NDArray %s @%s>" % ("x".join(str(d) for d in self.shape),
+                                     self.context)
+
+    def broadcast_to(self, shape):
+        return _invoke1("broadcast_to", [self], {"shape": tuple(shape)},
+                        self.context)
+
+    # engine var handle parity: the jax.Array itself is the synchronization token
+    @property
+    def handle(self):
+        return self.value
+
+
+# -------------------------------------------------------------- view plumbing
+def _apply_view(v, t):
+    if t[0] == "slice":
+        return v[t[1]:t[2]]
+    if t[0] == "at":
+        return v[t[1]]
+    if t[0] == "reshape":
+        return v.reshape(t[1])
+    raise MXNetError("bad view %r" % (t,))
+
+
+def _write_through(base_val, chain, value):
+    if not chain:
+        return value
+    t, rest = chain[0], chain[1:]
+    if t[0] == "slice":
+        sub = base_val[t[1]:t[2]]
+        return base_val.at[t[1]:t[2]].set(_write_through(sub, rest, value))
+    if t[0] == "at":
+        sub = base_val[t[1]]
+        return base_val.at[t[1]].set(_write_through(sub, rest, value))
+    if t[0] == "reshape":
+        cur = base_val.reshape(t[1])
+        return _write_through(cur, rest, value).reshape(base_val.shape)
+    raise MXNetError("bad view %r" % (t,))
+
+
+# ---------------------------------------------------------- invoke helpers
+def _wrap(arr, ctx):
+    return NDArray(arr, ctx=ctx)
+
+
+def _invoke(op_name, nds, attrs, ctx=None, out=None):
+    arrays = [a.value for a in nds]
+    outs, op = _reg.imperative_invoke(op_name, arrays, attrs)
+    ctx = ctx or (nds[0].context if nds else current_context())
+    n_vis = op.num_outputs_for(op.normalize_attrs(attrs or {}))
+    vis = outs[:n_vis]
+    # write aux updates back into trailing aux inputs (BatchNorm moving stats)
+    if op.num_aux:
+        for aux_nd, new_val in zip(nds[-op.num_aux:], outs[n_vis:n_vis + op.num_aux]):
+            aux_nd._set_value(new_val)
+    if out is not None:
+        outs_nd = out if isinstance(out, (list, tuple)) else [out]
+        for o, v in zip(outs_nd, vis):
+            o._set_value(v)
+        return out
+    wrapped = [_wrap(v, ctx) for v in vis]
+    return wrapped[0] if len(wrapped) == 1 else tuple(wrapped)
+
+
+def _invoke1(op_name, nds, attrs, ctx, out=None):
+    return _invoke(op_name, nds, attrs, ctx, out)
+
+
+def _binary(op, scalar_op, lhs, rhs):
+    if isinstance(rhs, NDArray):
+        if lhs.shape == rhs.shape:
+            return _invoke(op, [lhs, rhs], {})
+        return _invoke(_bcast_name(op), [lhs, rhs], {})
+    return _scalar(scalar_op, lhs, rhs)
+
+
+def _bcast_name(op):
+    return {"_plus": "broadcast_add", "_minus": "broadcast_sub",
+            "_mul": "broadcast_mul", "_div": "broadcast_div",
+            "_power": "broadcast_power", "_equal": "broadcast_equal",
+            "_not_equal": "broadcast_not_equal", "_greater": "broadcast_greater",
+            "_greater_equal": "broadcast_greater_equal",
+            "_lesser": "broadcast_lesser",
+            "_lesser_equal": "broadcast_lesser_equal",
+            "_maximum": "broadcast_maximum",
+            "_minimum": "broadcast_minimum"}[op]
+
+
+def _scalar(scalar_op, data, scalar):
+    return _invoke(scalar_op, [data], {"scalar": float(scalar)})
+
+
+# ------------------------------------------------------------- constructors
+def empty(shape, ctx=None, dtype=np.float32):
+    return zeros(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype=np.float32):
+    return _creation("_zeros", shape, ctx, dtype)
+
+
+def ones(shape, ctx=None, dtype=np.float32):
+    return _creation("_ones", shape, ctx, dtype)
+
+
+def full(shape, val, ctx=None, dtype=np.float32):
+    return _creation("_full", shape, ctx, dtype, value=float(val))
+
+
+def _creation(op, shape, ctx, dtype, **extra):
+    import jax
+    ctx = ctx or current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    attrs = dict(shape=tuple(shape), dtype=_reg.parse_dtype(dtype), **extra)
+    outs, _ = _reg.imperative_invoke(op, [], attrs)
+    arr = jax.device_put(outs[0], ctx.jax_device())
+    return NDArray(arr, ctx=ctx)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray from any array-like (parity: mx.nd.array)."""
+    import jax
+    ctx = ctx or current_context()
+    if isinstance(source_array, NDArray):
+        source_array = source_array.asnumpy()
+    arr = np.asarray(source_array)
+    if dtype is None:
+        dtype = {np.dtype(np.float64): np.float32,
+                 np.dtype(np.int64): np.int32}.get(arr.dtype, arr.dtype)
+    arr = jax.device_put(_jnp().asarray(arr, _reg.parse_dtype(dtype)),
+                         ctx.jax_device())
+    return NDArray(arr, ctx=ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=np.float32):
+    import jax
+    ctx = ctx or current_context()
+    outs, _ = _reg.imperative_invoke(
+        "_arange", [], {"start": float(start),
+                        "stop": None if stop is None else float(stop),
+                        "step": float(step), "repeat": int(repeat),
+                        "dtype": _reg.parse_dtype(dtype)})
+    return NDArray(jax.device_put(outs[0], ctx.jax_device()), ctx=ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    jnp = _jnp()
+    if len(arrays) == 1 and not always_copy:
+        return arrays[0]
+    return _wrap(jnp.concatenate([a.value for a in arrays], axis=axis),
+                 arrays[0].context)
+
+
+def onehot_encode(indices, out):
+    """(parity: mx.nd.onehot_encode)"""
+    depth = out.shape[1]
+    return _invoke("one_hot", [indices], {"depth": depth}, out=out)
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3,
+             mean=None):
+    """Decode an image bytes string via OpenCV (parity: mx.nd.imdecode)."""
+    import cv2
+    flag = cv2.IMREAD_COLOR if channels == 3 else cv2.IMREAD_GRAYSCALE
+    img = cv2.imdecode(np.frombuffer(str_img, dtype=np.uint8), flag)
+    img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB) if channels == 3 else img
+    if any(clip_rect):
+        x0, y0, x1, y1 = clip_rect
+        img = img[y0:y1, x0:x1]
+    arr = np.transpose(img, (2, 0, 1))[None].astype(np.float32)
+    if mean is not None:
+        arr = arr - mean.asnumpy()
+    nd = array(arr)
+    if out is not None:
+        out._set_value(nd.value)
+        return out
+    return nd
+
+
+def waitall():
+    """Block until all pending async work completes (parity: MXNDArrayWaitAll)."""
+    import jax
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+# ------------------------------------------------------------- serialization
+_MAGIC = 0xF993FAC9
+
+
+def _dtype_to_code(dt):
+    if "bfloat16" in str(dt):
+        return _BF16_CODE
+    return _DTYPE_CODE[np.dtype(dt)]
+
+
+def _code_to_dtype(code):
+    if code == _BF16_CODE:
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return _CODE_DTYPE[code]
+
+
+def save(fname, data):
+    """Save list/dict of NDArrays (parity: mx.nd.save, the .params format;
+    reference src/ndarray/ndarray.cc:652-686).  Binary format is magic-framed
+    like the reference but not byte-compatible (no mshadow blobs on TPU)."""
+    if isinstance(data, dict):
+        names, arrays = list(data.keys()), list(data.values())
+    else:
+        names, arrays = [""] * len(data), list(data)
+        if not all(isinstance(a, NDArray) for a in arrays):
+            raise MXNetError("save only supports NDArray contents")
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQ", _MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for name, arr in zip(names, arrays):
+            npv = np.asarray(arr.value)
+            nb = name.encode("utf-8")
+            f.write(struct.pack("<I", len(nb)))
+            f.write(nb)
+            f.write(struct.pack("<I", _dtype_to_code(arr.dtype)))
+            f.write(struct.pack("<I", npv.ndim))
+            f.write(struct.pack("<%dq" % npv.ndim, *npv.shape))
+            f.write(npv.tobytes())
+
+
+def load(fname):
+    """Load NDArrays saved by :func:`save` (parity: mx.nd.load)."""
+    with open(fname, "rb") as f:
+        magic, _ = struct.unpack("<QQ", f.read(16))
+        if magic != _MAGIC:
+            raise MXNetError("invalid NDArray file format")
+        n = struct.unpack("<Q", f.read(8))[0]
+        names, arrays = [], []
+        for _ in range(n):
+            ln = struct.unpack("<I", f.read(4))[0]
+            name = f.read(ln).decode("utf-8")
+            code = struct.unpack("<I", f.read(4))[0]
+            ndim = struct.unpack("<I", f.read(4))[0]
+            shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim else ()
+            dt = _code_to_dtype(code)
+            count = int(np.prod(shape)) if shape else 1
+            buf = f.read(count * dt.itemsize)
+            npv = np.frombuffer(buf, dtype=dt).reshape(shape)
+            names.append(name)
+            arrays.append(array(npv, dtype=dt))
+    if any(names):
+        return dict(zip(names, arrays))
+    return arrays
+
+
+# ------------------------------------------------- autogenerated op frontends
+def _make_ndarray_function(op):
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        nds = []
+        for a in args:
+            if isinstance(a, NDArray):
+                nds.append(a)
+            elif isinstance(a, (list, tuple)):
+                nds.extend(a)
+            else:
+                nds.append(array(a))
+        if op.key_var_num_args and op.key_var_num_args not in kwargs:
+            kwargs[op.key_var_num_args] = len(nds)
+        ctx = kwargs.pop("ctx", None)
+        if not nds:  # creation-style op
+            import jax
+            ctx = ctx or current_context()
+            outs, _ = _reg.imperative_invoke(op.name, [], kwargs)
+            return NDArray(jax.device_put(outs[0], ctx.jax_device()), ctx=ctx)
+        return _invoke(op.name, nds, kwargs, ctx, out=out)
+
+    fn.__name__ = op.name
+    fn.__doc__ = op.doc
+    return fn
+
+
+def _init_ndarray_module(target):
+    """Expose every registered op as a function (parity: _init_ndarray_module,
+    reference python/mxnet/ndarray.py autogen from MXListFunctions)."""
+    seen = {}
+    for name in _reg.list_ops():
+        if name in target:  # never shadow hand-written helpers (zeros, ones, ...)
+            continue
+        op = _reg.get_op(name)
+        fn = seen.get(id(op))
+        if fn is None:
+            fn = _make_ndarray_function(op)
+            seen[id(op)] = fn
+        target[name] = fn
+
+
+# populate module namespace with op functions (e.g. mx.nd.relu, mx.nd.dot)
+_init_ndarray_module(globals())
+# pythonic aliases used throughout examples
+transpose = globals()["transpose"]
+dot = globals()["dot"]

@@ -1,0 +1,19 @@
+"""Operator library (parity: reference src/operator — see SURVEY.md §2.5).
+
+Importing this package registers every operator family into the global registry.
+"""
+from . import registry
+from .registry import OpDef, register, get_op, list_ops, imperative_invoke
+
+# op families — import order is unimportant; each module registers on import
+from . import elemwise       # noqa: F401  (elemwise_unary/binary/scalar/broadcast)
+from . import init_ops       # noqa: F401  (init_op.cc)
+from . import matrix         # noqa: F401  (matrix_op.cc, concat, slice_channel, pad)
+from . import reduce_ops     # noqa: F401  (broadcast_reduce_op)
+from . import indexing       # noqa: F401  (indexing_op.cc, control_flow_op.cc)
+from . import sample_ops     # noqa: F401  (sample_op.cc)
+from . import ordering       # noqa: F401  (ordering_op.cc)
+from . import nn             # noqa: F401  (conv/pool/bn/act/dropout/...)
+from . import loss           # noqa: F401  (softmax_output/regression/make_loss/svm)
+from . import optimizer_ops  # noqa: F401  (optimizer_op.cc)
+from . import sequence       # noqa: F401  (sequence_*.cc)

@@ -1,0 +1,187 @@
+"""Elementwise operators (parity: reference src/operator/tensor/elemwise_unary_op.cc,
+elemwise_binary_op_*.cc, elemwise_binary_scalar_op_*.cc,
+elemwise_binary_broadcast_op_*.cc, elemwise_sum.cc, mshadow_op.h functor zoo).
+
+Every op is a pure jnp expression; XLA fuses chains of these into single kernels, so
+there is no need for the reference's manual Kernel<OP,xpu>::Launch machinery.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .registry import register, parse_dtype, parse_int
+
+
+def _same_shape_infer(n_in):
+    def infer(attrs, in_shapes):
+        known = next((s for s in in_shapes if s is not None), None)
+        ins = [s if s is not None else known for s in in_shapes]
+        return ins, [known], None
+    return infer
+
+
+# ------------------------------------------------------------------ unary ops
+def _gamma(x):
+    from jax.scipy.special import gammaln
+    return jnp.exp(gammaln(x)) * jnp.where(x > 0, 1.0, jnp.cos(jnp.pi * x) /
+                                           jnp.abs(jnp.cos(jnp.pi * x)))
+
+
+_UNARY = {
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "_copy": lambda x: x,
+    "negative": jnp.negative,
+    "reciprocal": jnp.reciprocal,
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "round": jnp.round,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "rint": jnp.rint,
+    "fix": jnp.trunc,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "gamma": _gamma,
+    "gammaln": lambda x: jax.scipy.special.gammaln(x),
+}
+
+for _name, _f in _UNARY.items():
+    register(_name, aliases=("identity",) if _name == "_copy" else ())(
+        (lambda f: lambda data: f(data))(_f))
+
+register("BlockGrad", aliases=("stop_gradient",))(
+    lambda data: jax.lax.stop_gradient(data))
+
+
+@register("Cast", aliases=("cast",),
+          attr_types={"dtype": parse_dtype}, defaults={"dtype": _np.float32},
+          infer_type=lambda attrs, in_dt: (in_dt, [attrs.get("dtype", _np.float32)], []))
+def _cast(data, dtype=_np.float32):
+    """Cast to dtype (parity: elemwise_unary_op.cc Cast)."""
+    return data.astype(dtype)
+
+
+@register("_identity_with_attr_like_rhs", arg_names=("lhs", "rhs"), hidden=True)
+def _identity_like_rhs(lhs, rhs):
+    return lhs
+
+
+# ----------------------------------------------------------------- binary ops
+_BINARY = {
+    "_plus": (jnp.add, ("_add", "elemwise_add")),
+    "_minus": (jnp.subtract, ("_sub", "elemwise_sub")),
+    "_mul": (jnp.multiply, ("elemwise_mul",)),
+    "_div": (jnp.divide, ("elemwise_div",)),
+    "_power": (jnp.power, ()),
+    "_maximum": (jnp.maximum, ()),
+    "_minimum": (jnp.minimum, ()),
+    "_hypot": (jnp.hypot, ()),
+    "_grad_add": (jnp.add, ()),
+    "_equal": (lambda a, b: (a == b).astype(a.dtype), ()),
+    "_not_equal": (lambda a, b: (a != b).astype(a.dtype), ()),
+    "_greater": (lambda a, b: (a > b).astype(a.dtype), ()),
+    "_greater_equal": (lambda a, b: (a >= b).astype(a.dtype), ()),
+    "_lesser": (lambda a, b: (a < b).astype(a.dtype), ()),
+    "_lesser_equal": (lambda a, b: (a <= b).astype(a.dtype), ()),
+}
+
+for _name, (_f, _al) in _BINARY.items():
+    register(_name, arg_names=("lhs", "rhs"), aliases=_al,
+             infer_shape=_same_shape_infer(2))(
+        (lambda f: lambda lhs, rhs: f(lhs, rhs))(_f))
+
+# broadcast variants (parity: elemwise_binary_broadcast_op_*.cc)
+_BCAST = {
+    "broadcast_add": jnp.add, "broadcast_plus": jnp.add,
+    "broadcast_sub": jnp.subtract, "broadcast_minus": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "broadcast_equal": lambda a, b: (a == b).astype(a.dtype),
+    "broadcast_not_equal": lambda a, b: (a != b).astype(a.dtype),
+    "broadcast_greater": lambda a, b: (a > b).astype(a.dtype),
+    "broadcast_greater_equal": lambda a, b: (a >= b).astype(a.dtype),
+    "broadcast_lesser": lambda a, b: (a < b).astype(a.dtype),
+    "broadcast_lesser_equal": lambda a, b: (a <= b).astype(a.dtype),
+}
+for _name, _f in _BCAST.items():
+    if _name in ("broadcast_plus", "broadcast_minus"):
+        continue  # registered as aliases below
+    _al = {"broadcast_add": ("broadcast_plus",),
+           "broadcast_sub": ("broadcast_minus",)}.get(_name, ())
+    register(_name, arg_names=("lhs", "rhs"), aliases=_al)(
+        (lambda f: lambda lhs, rhs: f(lhs, rhs))(_f))
+
+
+# ----------------------------------------------------------------- scalar ops
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)),
+    "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+}
+for _name, _f in _SCALAR.items():
+    register(_name, attr_types={"scalar": float}, defaults={"scalar": 0.0})(
+        (lambda f: lambda data, scalar=0.0: f(data, scalar))(_f))
+
+
+@register("smooth_l1", attr_types={"scalar": float}, defaults={"scalar": 1.0})
+def _smooth_l1(data, scalar=1.0):
+    """Smooth-L1 (parity: mshadow_op.h smooth_l1_loss, used by RCNN examples)."""
+    s2 = scalar * scalar
+    absd = jnp.abs(data)
+    return jnp.where(absd < 1.0 / s2, 0.5 * s2 * data * data, absd - 0.5 / s2)
+
+
+# ---------------------------------------------------------------- variadic sum
+@register("add_n", aliases=("ElementWiseSum", "_sum"),
+          arg_names=lambda attrs: ["arg%d" % i for i in range(int(attrs.get("num_args", 1)))],
+          key_var_num_args="num_args",
+          attr_types={"num_args": parse_int},
+          infer_shape=lambda attrs, ins: (
+              [next((s for s in ins if s is not None), None)] * len(ins),
+              [next((s for s in ins if s is not None), None)], None))
+def _add_n(*args, num_args=None):
+    """Variadic sum (parity: elemwise_sum.cc ElementWiseSum; grad-aggregation op)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# --------------------------------------------------------------------- clip
+@register("clip", attr_types={"a_min": float, "a_max": float},
+          defaults={"a_min": 0.0, "a_max": 0.0})
+def _clip(data, a_min=0.0, a_max=0.0):
+    """Clip to [a_min, a_max] (parity: matrix_op.cc clip)."""
+    return jnp.clip(data, a_min, a_max)

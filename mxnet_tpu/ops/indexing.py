@@ -1,0 +1,76 @@
+"""Indexing ops (parity: reference src/operator/tensor/indexing_op.cc/-inl.h).
+
+Gathers lower to XLA gather, which TPU executes efficiently from HBM; no custom
+kernels needed."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .registry import register, parse_dtype, parse_int, parse_float
+
+
+def _embedding_infer(attrs, in_shapes):
+    data, weight = in_shapes
+    in_dim = int(attrs.get("input_dim"))
+    out_dim = int(attrs.get("output_dim"))
+    w = (in_dim, out_dim)
+    out = None if data is None else tuple(data) + (out_dim,)
+    return [data, w], [out], None
+
+
+@register("Embedding", arg_names=("data", "weight"),
+          attr_types={"input_dim": parse_int, "output_dim": parse_int,
+                      "dtype": parse_dtype},
+          defaults={"dtype": _np.float32},
+          infer_shape=_embedding_infer)
+def _embedding(data, weight, input_dim=None, output_dim=None, dtype=_np.float32):
+    """Embedding lookup (parity: indexing_op.h EmbeddingOp)."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("take", arg_names=("a", "indices"),
+          attr_types={"axis": parse_int, "mode": str},
+          defaults={"axis": 0, "mode": "clip"})
+def _take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    elif mode == "wrap":
+        idx = idx % a.shape[axis]
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("batch_take", arg_names=("a", "indices"))
+def _batch_take(a, indices):
+    """out[i] = a[i, indices[i]] (parity: indexing_op.cc batch_take)."""
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32).reshape(-1, 1), axis=1)[:, 0]
+
+
+@register("one_hot",
+          attr_types={"depth": parse_int, "on_value": parse_float,
+                      "off_value": parse_float, "dtype": parse_dtype},
+          defaults={"depth": 1, "on_value": 1.0, "off_value": 0.0,
+                    "dtype": _np.float32},
+          infer_shape=lambda attrs, ins: (
+              ins, [None if ins[0] is None else
+                    tuple(ins[0]) + (int(attrs.get("depth", 1)),)], None),
+          infer_type=lambda attrs, in_dt: (
+              in_dt, [attrs.get("dtype") or _np.float32], []))
+def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype=_np.float32):
+    idx = indices.astype(jnp.int32)
+    oh = jax.nn.one_hot(idx, depth, dtype=jnp.float32)
+    return (oh * (on_value - off_value) + off_value).astype(dtype)
+
+
+@register("where", arg_names=("condition", "x", "y"),
+          infer_shape=lambda attrs, ins: (
+              ins, [next((s for s in ins[1:] if s is not None), None)], None))
+def _where(condition, x, y):
+    """(parity: src/operator/tensor/control_flow_op.cc where)"""
+    cond = condition
+    if cond.ndim == 1 and x.ndim > 1:
+        cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(cond != 0, x, y)

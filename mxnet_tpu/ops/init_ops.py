@@ -1,0 +1,78 @@
+"""Creation operators (parity: reference src/operator/tensor/init_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as _np
+
+from .registry import register, parse_dtype, parse_tuple
+
+
+def _init_infer(attrs, in_shapes):
+    shape = parse_tuple(attrs.get("shape", ()))
+    return [], [tuple(shape)], None
+
+
+def _init_type(attrs, in_dtypes):
+    return [], [attrs.get("dtype") or _np.float32], []
+
+
+@register("_zeros", arg_names=(), aliases=("zeros",),
+          attr_types={"shape": parse_tuple, "dtype": parse_dtype},
+          defaults={"shape": (), "dtype": _np.float32},
+          infer_shape=_init_infer, infer_type=_init_type)
+def _zeros(shape=(), dtype=_np.float32):
+    return jnp.zeros(shape, dtype)
+
+
+@register("_ones", arg_names=(), aliases=("ones",),
+          attr_types={"shape": parse_tuple, "dtype": parse_dtype},
+          defaults={"shape": (), "dtype": _np.float32},
+          infer_shape=_init_infer, infer_type=_init_type)
+def _ones(shape=(), dtype=_np.float32):
+    return jnp.ones(shape, dtype)
+
+
+@register("_full", arg_names=(), aliases=("full",),
+          attr_types={"shape": parse_tuple, "dtype": parse_dtype, "value": float},
+          defaults={"shape": (), "dtype": _np.float32, "value": 0.0},
+          infer_shape=_init_infer, infer_type=_init_type)
+def _full(shape=(), dtype=_np.float32, value=0.0):
+    return jnp.full(shape, value, dtype)
+
+
+def _arange_infer(attrs, in_shapes):
+    start = float(attrs.get("start", 0.0))
+    stop = attrs.get("stop", None)
+    if stop is None or (isinstance(stop, str) and stop == "None"):
+        start, stop = 0.0, start
+    stop = float(stop)
+    step = float(attrs.get("step", 1.0))
+    repeat = int(attrs.get("repeat", 1))
+    n = int(max(0, _np.ceil((stop - start) / step))) * repeat
+    return [], [(n,)], None
+
+
+@register("_arange", arg_names=(), aliases=("arange",),
+          attr_types={"start": float, "stop": lambda v: None if v in (None, "None") else float(v),
+                      "step": float, "repeat": int, "dtype": parse_dtype},
+          defaults={"start": 0.0, "stop": None, "step": 1.0, "repeat": 1,
+                    "dtype": _np.float32},
+          infer_shape=_arange_infer, infer_type=_init_type)
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype=_np.float32):
+    """arange with MXNet's repeat extension (parity: init_op.cc _arange)."""
+    if stop is None:
+        start, stop = 0.0, start
+    out = jnp.arange(start, stop, step, dtype=dtype)
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("zeros_like")
+def _zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def _ones_like(data):
+    return jnp.ones_like(data)

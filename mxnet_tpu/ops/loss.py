@@ -1,0 +1,233 @@
+"""Output/loss operators with non-autodiff gradient semantics (parity: reference
+src/operator/softmax_output-inl.h, regression_output-inl.h, make_loss-inl.h,
+svm_output-inl.h, src/operator/loss_binary_op.cc).
+
+MXNet loss heads define their *own* backward (e.g. SoftmaxOutput's grad is
+``softmax - one_hot(label)`` regardless of head gradient).  TPU-natively this is a
+``jax.custom_vjp`` wrapped around the forward expression, so whole-graph autodiff
+reproduces the reference executor's backward exactly.  VJP instances are cached per
+attr-combo (attrs are static under jit).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .registry import register, parse_bool, parse_float, parse_str
+
+
+def _softmax_out_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, [None], None
+    if attrs.get("multi_output", False):
+        label = (data[0],) + tuple(data[2:])
+    else:
+        label = (data[0],)
+    return [data, label], [data], None
+
+
+@functools.lru_cache(maxsize=None)
+def _softmax_output_fn(grad_scale, ignore_label, multi_output, use_ignore,
+                       preserve_shape, normalization):
+    axis = 1 if multi_output else -1
+
+    def _fwd_compute(data):
+        if preserve_shape or multi_output:
+            return jax.nn.softmax(data, axis=axis)
+        return jax.nn.softmax(data.reshape(data.shape[0], -1),
+                              axis=-1).reshape(data.shape)
+
+    @jax.custom_vjp
+    def f(data, label):
+        return _fwd_compute(data)
+
+    def fwd(data, label):
+        out = _fwd_compute(data)
+        return out, (out, label)
+
+    def bwd(res, g):
+        out, label = res
+        nclass = out.shape[axis]
+        lab = label.astype(jnp.int32)
+        if multi_output:
+            onehot = jnp.moveaxis(jax.nn.one_hot(lab, nclass, dtype=out.dtype),
+                                  -1, 1)
+        else:
+            onehot = jax.nn.one_hot(lab.reshape(out.shape[0]), nclass,
+                                    dtype=out.dtype).reshape(out.shape)
+        grad = out - onehot
+        if use_ignore:
+            mask = (label != ignore_label).astype(out.dtype)
+            if multi_output:
+                grad = grad * jnp.expand_dims(mask, 1)
+            else:
+                grad = grad * mask.reshape((-1,) + (1,) * (out.ndim - 1))
+        if normalization == "batch":
+            grad = grad / out.shape[0]
+        elif normalization == "valid":
+            if use_ignore:
+                valid = jnp.maximum(jnp.sum(label != ignore_label), 1)
+            else:
+                valid = label.size
+            grad = grad / valid
+        return grad * grad_scale, jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@register("SoftmaxOutput", aliases=("Softmax",), arg_names=("data", "label"),
+          attr_types={"grad_scale": parse_float, "ignore_label": parse_float,
+                      "multi_output": parse_bool, "use_ignore": parse_bool,
+                      "preserve_shape": parse_bool, "normalization": parse_str,
+                      "out_grad": parse_bool, "smooth_alpha": parse_float},
+          defaults={"grad_scale": 1.0, "ignore_label": -1.0,
+                    "multi_output": False, "use_ignore": False,
+                    "preserve_shape": False, "normalization": "null"},
+          infer_shape=_softmax_out_infer)
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                    multi_output=False, use_ignore=False, preserve_shape=False,
+                    normalization="null", out_grad=False, smooth_alpha=0.0):
+    """Softmax with cross-entropy gradient (parity: softmax_output-inl.h)."""
+    fn = _softmax_output_fn(grad_scale, ignore_label, multi_output, use_ignore,
+                            preserve_shape, normalization)
+    return fn(data, label)
+
+
+@functools.lru_cache(maxsize=None)
+def _regression_fn(kind, grad_scale):
+    def _fwd_compute(data):
+        return jax.nn.sigmoid(data) if kind == "logistic" else data
+
+    @jax.custom_vjp
+    def f(data, label):
+        return _fwd_compute(data)
+
+    def fwd(data, label):
+        out = _fwd_compute(data)
+        return out, (out, label)
+
+    def bwd(res, g):
+        out, label = res
+        lab = label.reshape(out.shape)
+        num_output = max(1, int(_np.prod(out.shape[1:])))
+        diff = jnp.sign(out - lab) if kind == "mae" else (out - lab)
+        return diff * (grad_scale / num_output), jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _reg_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, [None], None
+    label = in_shapes[1]
+    if label is None:
+        # 1-output nets accept 1-D labels (parity: regression_output-inl.h:113-121)
+        label = (data[0],) if (len(data) == 2 and data[1] == 1) else data
+    return [data, label], [data], None
+
+
+def _make_regression(name, kind):
+    @register(name, arg_names=("data", "label"),
+              attr_types={"grad_scale": parse_float},
+              defaults={"grad_scale": 1.0}, infer_shape=_reg_infer)
+    def _fn(data, label, grad_scale=1.0, _kind=kind):
+        return _regression_fn(_kind, grad_scale)(data, label)
+    return _fn
+
+
+_make_regression("LinearRegressionOutput", "linear")
+_make_regression("LogisticRegressionOutput", "logistic")
+_make_regression("MAERegressionOutput", "mae")
+
+
+@functools.lru_cache(maxsize=None)
+def _make_loss_fn(grad_scale, valid_thresh, normalization):
+    @jax.custom_vjp
+    def f(data):
+        return data
+
+    def fwd(data):
+        return data, data
+
+    def bwd(data, g):
+        grad = jnp.full(data.shape, grad_scale, data.dtype)
+        if normalization == "batch":
+            grad = grad / data.shape[0]
+        elif normalization == "valid":
+            valid = jnp.maximum(jnp.sum(data > valid_thresh), 1).astype(data.dtype)
+            grad = grad / valid
+        return (grad,)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@register("MakeLoss",
+          attr_types={"grad_scale": parse_float, "valid_thresh": parse_float,
+                      "normalization": parse_str},
+          defaults={"grad_scale": 1.0, "valid_thresh": 0.0,
+                    "normalization": "null"})
+def _make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    """Identity forward, constant grad_scale backward (parity: make_loss-inl.h)."""
+    return _make_loss_fn(grad_scale, valid_thresh, normalization)(data)
+
+
+@functools.lru_cache(maxsize=None)
+def _svm_output_fn(margin, reg_coef, use_linear):
+    @jax.custom_vjp
+    def f(data, label):
+        return data
+
+    def fwd(data, label):
+        return data, (data, label)
+
+    def bwd(res, g):
+        out, label = res
+        nclass = out.shape[1]
+        onehot = jax.nn.one_hot(label.astype(jnp.int32), nclass, dtype=out.dtype)
+        ycoef = 2.0 * onehot - 1.0  # +1 for true class, -1 otherwise
+        if use_linear:
+            # L1-SVM: hinge active where margin violated
+            active = (margin - ycoef * out) > 0
+            grad = jnp.where(active, -ycoef, 0.0) * reg_coef
+        else:
+            # L2-SVM
+            viol = jnp.maximum(margin - ycoef * out, 0.0)
+            grad = -2.0 * reg_coef * viol * ycoef
+        return grad.astype(out.dtype), jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@register("SVMOutput", arg_names=("data", "label"),
+          attr_types={"margin": parse_float,
+                      "regularization_coefficient": parse_float,
+                      "use_linear": parse_bool},
+          defaults={"margin": 1.0, "regularization_coefficient": 1.0,
+                    "use_linear": False},
+          infer_shape=lambda attrs, ins: (
+              [ins[0], None if ins[0] is None else (ins[0][0],)],
+              [ins[0]], None))
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False):
+    """(parity: svm_output-inl.h)"""
+    return _svm_output_fn(margin, regularization_coefficient, use_linear)(
+        data, label)
+
+
+@register("softmax_cross_entropy", arg_names=("data", "label"),
+          infer_shape=lambda attrs, ins: (ins, [(1,)], None))
+def _softmax_cross_entropy(data, label):
+    """Scalar CE loss (parity: src/operator/loss_binary_op.cc)."""
+    lab = jax.lax.stop_gradient(label).astype(jnp.int32)
+    logp = jax.nn.log_softmax(data, axis=-1)
+    picked = jnp.take_along_axis(logp, lab.reshape(-1, 1), axis=1)
+    return -jnp.sum(picked).reshape((1,))

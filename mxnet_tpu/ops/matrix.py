@@ -1,0 +1,232 @@
+"""Shape-manipulation and linear-algebra ops (parity: reference
+src/operator/tensor/matrix_op.cc / matrix_op-inl.h, swapaxis.cc).
+
+dot/batch_dot map straight onto the MXU via jax.lax.dot_general in whatever
+precision the inputs carry (bf16 inputs → bf16 MXU passes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import register, parse_bool, parse_int, parse_tuple
+
+
+def infer_reshape(shape, target):
+    """MXNet reshape semantics incl. special codes 0, -1, -2, -3, -4
+    (parity: matrix_op-inl.h ReshapeParam)."""
+    src = list(shape)
+    out = []
+    src_idx = 0
+    i = 0
+    target = list(target)
+    while i < len(target):
+        t = target[i]
+        if t == 0:
+            out.append(src[src_idx]); src_idx += 1
+        elif t == -1:
+            out.append(-1); src_idx += 1
+        elif t == -2:
+            out.extend(src[src_idx:]); src_idx = len(src)
+        elif t == -3:
+            out.append(src[src_idx] * src[src_idx + 1]); src_idx += 2
+        elif t == -4:
+            d1, d2 = target[i + 1], target[i + 2]
+            cur = src[src_idx]
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2]); src_idx += 1; i += 2
+        else:
+            out.append(t); src_idx += 1
+        i += 1
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = int(_np.prod(shape)) if shape else 1
+        out[out.index(-1)] = total // known
+    return tuple(out)
+
+
+def _reshape_infer(attrs, in_shapes):
+    s = in_shapes[0]
+    if s is None:
+        return in_shapes, [None], None
+    tgt = parse_tuple(attrs.get("shape", ())) or ()
+    if not tgt and attrs.get("target_shape") is not None:
+        tgt = parse_tuple(attrs["target_shape"])
+    return in_shapes, [infer_reshape(s, tgt)], None
+
+
+@register("Reshape", aliases=("reshape",),
+          attr_types={"shape": parse_tuple, "target_shape": parse_tuple,
+                      "keep_highest": parse_bool, "reverse": parse_bool},
+          defaults={"shape": (), "reverse": False},
+          infer_shape=_reshape_infer)
+def _reshape(data, shape=(), target_shape=None, keep_highest=False, reverse=False):
+    tgt = tuple(shape) if shape else tuple(target_shape or ())
+    return jnp.reshape(data, infer_reshape(data.shape, tgt))
+
+
+@register("Flatten", aliases=("flatten",),
+          infer_shape=lambda attrs, ins: (
+              ins, [None if ins[0] is None else
+                    (ins[0][0], int(_np.prod(ins[0][1:])))], None))
+def _flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose", attr_types={"axes": parse_tuple}, defaults={"axes": ()})
+def _transpose(data, axes=()):
+    return jnp.transpose(data, axes if axes else None)
+
+
+@register("expand_dims", attr_types={"axis": parse_int}, defaults={"axis": 0})
+def _expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("SwapAxis", aliases=("swapaxes",),
+          attr_types={"dim1": parse_int, "dim2": parse_int},
+          defaults={"dim1": 0, "dim2": 0})
+def _swapaxes(data, dim1=0, dim2=0):
+    """(parity: src/operator/swapaxis.cc)"""
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("slice", aliases=("crop",),
+          attr_types={"begin": parse_tuple, "end": parse_tuple},
+          defaults={"begin": (), "end": ()})
+def _slice(data, begin=(), end=()):
+    idx = tuple(slice(b, None if e is None else e) for b, e in zip(begin, end))
+    return data[idx]
+
+
+@register("slice_axis",
+          attr_types={"axis": parse_int, "begin": parse_int, "end": parse_int},
+          defaults={"axis": 0, "begin": 0, "end": None})
+def _slice_axis(data, axis=0, begin=0, end=None):
+    n = data.shape[axis]
+    if end is None:
+        end = n
+    if begin < 0:
+        begin += n
+    if end < 0:
+        end += n
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+def _dot_infer(attrs, in_shapes):
+    a, b = in_shapes
+    ta = attrs.get("transpose_a", False)
+    tb = attrs.get("transpose_b", False)
+    if a is None or b is None:
+        return in_shapes, [None], None
+    ash = tuple(reversed(a)) if ta else a
+    bsh = tuple(reversed(b)) if tb else b
+    if len(a) == 1 and len(b) == 1:
+        return in_shapes, [()], None
+    return in_shapes, [(ash[0], bsh[1])], None
+
+
+@register("dot", arg_names=("lhs", "rhs"),
+          attr_types={"transpose_a": parse_bool, "transpose_b": parse_bool},
+          defaults={"transpose_a": False, "transpose_b": False},
+          infer_shape=_dot_infer)
+def _dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """MXU matmul (parity: matrix_op.cc dot via mshadow/cuBLAS)."""
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    return jnp.dot(a, b)
+
+
+@register("batch_dot", arg_names=("lhs", "rhs"),
+          attr_types={"transpose_a": parse_bool, "transpose_b": parse_bool},
+          defaults={"transpose_a": False, "transpose_b": False})
+def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jax.lax.batch_matmul(a, b)
+
+
+@register("repeat", attr_types={"repeats": parse_int, "axis": parse_int},
+          defaults={"repeats": 1, "axis": None})
+def _repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("tile", attr_types={"reps": parse_tuple}, defaults={"reps": ()})
+def _tile(data, reps=()):
+    return jnp.tile(data, reps)
+
+
+@register("reverse", aliases=("flip",), attr_types={"axis": parse_tuple},
+          defaults={"axis": ()})
+def _reverse(data, axis=()):
+    ax = axis if isinstance(axis, (tuple, list)) else (axis,)
+    return jnp.flip(data, ax)
+
+
+def _concat_infer(attrs, in_shapes):
+    dim = int(attrs.get("dim", 1))
+    num = int(attrs.get("num_args", len(in_shapes)))
+    known = next((s for s in in_shapes if s is not None), None)
+    if known is None:
+        return in_shapes, [None], None
+    ins = [s if s is not None else known for s in in_shapes]
+    out = list(known)
+    out[dim] = sum(s[dim] for s in ins)
+    return ins, [tuple(out)], None
+
+
+@register("Concat", aliases=("concat",),
+          arg_names=lambda attrs: ["arg%d" % i
+                                   for i in range(int(attrs.get("num_args", 1)))],
+          key_var_num_args="num_args",
+          attr_types={"num_args": parse_int, "dim": parse_int},
+          defaults={"dim": 1}, infer_shape=_concat_infer)
+def _concat(*args, num_args=None, dim=1):
+    """(parity: src/operator/concat.cc)"""
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("SliceChannel", aliases=("split",),
+          num_outputs=lambda attrs: int(attrs.get("num_outputs", 1)),
+          attr_types={"num_outputs": parse_int, "axis": parse_int,
+                      "squeeze_axis": parse_bool},
+          defaults={"num_outputs": 1, "axis": 1, "squeeze_axis": False})
+def _slice_channel(data, num_outputs=1, axis=1, squeeze_axis=False):
+    """(parity: src/operator/slice_channel.cc)"""
+    outs = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        outs = [jnp.squeeze(o, axis=axis) for o in outs]
+    return tuple(outs)
+
+
+@register("stack",
+          arg_names=lambda attrs: ["arg%d" % i
+                                   for i in range(int(attrs.get("num_args", 1)))],
+          key_var_num_args="num_args",
+          attr_types={"num_args": parse_int, "axis": parse_int},
+          defaults={"axis": 0})
+def _stack(*args, num_args=None, axis=0):
+    return jnp.stack(args, axis=axis)
+
+
+@register("Pad", aliases=("pad",),
+          attr_types={"pad_width": parse_tuple, "mode": str,
+                      "constant_value": float},
+          defaults={"mode": "constant", "pad_width": (), "constant_value": 0.0})
+def _pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    """(parity: src/operator/pad.cc; modes constant/edge/reflect)"""
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(data, pw, constant_values=constant_value)
+    return jnp.pad(data, pw, mode={"edge": "edge", "reflect": "reflect"}[mode])

@@ -1,0 +1,470 @@
+"""Neural-network layers (parity: reference src/operator/{fully_connected,
+convolution,pooling,activation,batch_norm,dropout,leaky_relu,lrn,l2_normalization,
+instance_norm,deconvolution,upsampling}-inl.h and their cuDNN twins).
+
+TPU-first notes:
+- Convolutions lower to ``lax.conv_general_dilated`` — XLA tiles them onto the MXU
+  and picks TPU-friendly layouts itself; there is no im2col/cuDNN-algo machinery.
+- BatchNorm/activations are jnp expressions that XLA fuses into neighbouring convs
+  (replacing the hand-fused cuDNN/MKL paths).
+- All layers are rank-polymorphic over 1D/2D/3D spatial dims where MXNet's are.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import (register, parse_bool, parse_float, parse_int, parse_str,
+                       parse_tuple)
+
+
+# --------------------------------------------------------------- FullyConnected
+def _fc_args(attrs):
+    return ["data", "weight"] if attrs.get("no_bias", False) else \
+        ["data", "weight", "bias"]
+
+
+def _fc_infer(attrs, in_shapes):
+    nh = int(attrs.get("num_hidden"))
+    data = in_shapes[0]
+    ins = list(in_shapes)
+    if data is not None:
+        flat = int(_np.prod(data[1:]))
+        ins[1] = (nh, flat)
+    if len(ins) > 2:
+        ins[2] = (nh,)
+    out = None if data is None else (data[0], nh)
+    return ins, [out], None
+
+
+@register("FullyConnected", arg_names=_fc_args,
+          attr_types={"num_hidden": parse_int, "no_bias": parse_bool},
+          defaults={"no_bias": False},
+          infer_shape=_fc_infer)
+def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False):
+    """y = x·Wᵀ + b (parity: fully_connected-inl.h; MXU matmul)."""
+    x = data.reshape((data.shape[0], -1))
+    y = jnp.dot(x, weight.T)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+# ------------------------------------------------------------------ Activation
+@register("Activation", attr_types={"act_type": parse_str},
+          defaults={"act_type": "relu"})
+def _activation(data, act_type="relu"):
+    if act_type == "relu":
+        return jnp.maximum(data, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    raise MXNetError("unknown act_type %s" % act_type)
+
+
+def _lrelu_args(attrs):
+    return ["data", "gamma"] if attrs.get("act_type", "leaky") == "prelu" else ["data"]
+
+
+def _lrelu_infer(attrs, in_shapes):
+    ins = list(in_shapes)
+    if len(ins) > 1 and ins[0] is not None:
+        ins[1] = (ins[0][1],)
+    return ins, [ins[0]], None
+
+
+@register("LeakyReLU", arg_names=_lrelu_args,
+          attr_types={"act_type": parse_str, "slope": parse_float,
+                      "lower_bound": parse_float, "upper_bound": parse_float},
+          defaults={"act_type": "leaky", "slope": 0.25, "lower_bound": 0.125,
+                    "upper_bound": 0.334},
+          infer_shape=_lrelu_infer, needs_rng=True, train_aware=True)
+def _leaky_relu(data, gamma=None, rng=None, is_train=False, act_type="leaky",
+                slope=0.25, lower_bound=0.125, upper_bound=0.334):
+    """(parity: leaky_relu-inl.h; leaky/prelu/elu/rrelu)"""
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * (jnp.exp(data) - 1.0))
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "rrelu":
+        if is_train:
+            s = jax.random.uniform(rng, data.shape, data.dtype,
+                                   lower_bound, upper_bound)
+        else:
+            s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data > 0, data, s * data)
+    raise MXNetError("unknown act_type %s" % act_type)
+
+
+# ----------------------------------------------------------------- Convolution
+def _conv_args(attrs):
+    return ["data", "weight"] if attrs.get("no_bias", False) else \
+        ["data", "weight", "bias"]
+
+
+def _conv_out_dim(i, k, s, p, d):
+    return (i + 2 * p - (d * (k - 1) + 1)) // s + 1
+
+
+def _tup(v, n, default):
+    v = tuple(v) if v else ()
+    return v + (default,) * (n - len(v))
+
+
+def _conv_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    nf = int(attrs.get("num_filter"))
+    ng = int(attrs.get("num_group", 1))
+    kernel = parse_tuple(attrs.get("kernel"))
+    nd = len(kernel)
+    stride = _tup(parse_tuple(attrs.get("stride", ())), nd, 1)
+    pad = _tup(parse_tuple(attrs.get("pad", ())), nd, 0)
+    dilate = _tup(parse_tuple(attrs.get("dilate", ())), nd, 1)
+    ins = list(in_shapes)
+    out = None
+    if data is not None:
+        ins[1] = (nf, data[1] // ng) + kernel
+        spatial = tuple(_conv_out_dim(i, k, s, p, d) for i, k, s, p, d
+                        in zip(data[2:], kernel, stride, pad, dilate))
+        out = (data[0], nf) + spatial
+    if len(ins) > 2:
+        ins[2] = (nf,)
+    return ins, [out], None
+
+
+_CONV_ATTRS = {"kernel": parse_tuple, "stride": parse_tuple, "dilate": parse_tuple,
+               "pad": parse_tuple, "num_filter": parse_int, "num_group": parse_int,
+               "workspace": parse_int, "no_bias": parse_bool,
+               "cudnn_tune": parse_str, "cudnn_off": parse_bool, "layout": parse_str}
+
+
+@register("Convolution", arg_names=_conv_args,
+          attr_types=_CONV_ATTRS,
+          defaults={"stride": (), "dilate": (), "pad": (), "num_group": 1,
+                    "no_bias": False},
+          infer_shape=_conv_infer)
+def _convolution(data, weight, bias=None, kernel=None, stride=(), dilate=(),
+                 pad=(), num_filter=None, num_group=1, workspace=None,
+                 no_bias=False, cudnn_tune=None, cudnn_off=False, layout=None):
+    """N-D convolution (parity: convolution-inl.h / cudnn_convolution-inl.h).
+
+    Lowered to one XLA conv HLO; `workspace`/`cudnn_*` accepted for API parity
+    and ignored (XLA owns algorithm choice on TPU)."""
+    nd = len(kernel)
+    stride = _tup(stride, nd, 1)
+    dilate = _tup(dilate, nd, 1)
+    pad = _tup(pad, nd, 0)
+    spatial = "DHW"[-nd:] if nd <= 3 else None
+    if spatial is None:
+        raise MXNetError("Convolution supports 1-3 spatial dims")
+    dn = ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+    out = jax.lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Deconvolution", arg_names=_conv_args,
+          attr_types=dict(_CONV_ATTRS, adj=parse_tuple, target_shape=parse_tuple),
+          defaults={"stride": (), "dilate": (), "pad": (), "adj": (),
+                    "num_group": 1, "no_bias": True},
+          infer_shape=lambda attrs, ins: _deconv_infer(attrs, ins))
+def _deconvolution(data, weight, bias=None, kernel=None, stride=(), dilate=(),
+                   pad=(), adj=(), target_shape=None, num_filter=None,
+                   num_group=1, workspace=None, no_bias=True, cudnn_tune=None,
+                   cudnn_off=False, layout=None):
+    """Transposed convolution (parity: deconvolution-inl.h).
+
+    Implemented as an input-dilated conv with a spatially flipped kernel —
+    the exact adjoint of `Convolution`, which XLA recognises and maps to MXU."""
+    nd = len(kernel)
+    stride = _tup(stride, nd, 1)
+    pad_ = _tup(pad, nd, 0)
+    adj_ = _tup(adj, nd, 0)
+    # weight layout in MXNet deconv: (in_ch, out_ch/group, *kernel)
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    if num_group > 1:
+        cin = data.shape[1]
+        w = w.reshape((num_group, cin // num_group) + w.shape[1:])
+        w = jnp.swapaxes(w, 1, 2)
+        w = w.reshape((-1, cin // num_group) + kernel)  # (out, in/g, *k)
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    spatial = "DHW"[-nd:]
+    dn = ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+    padding = [(k - 1 - p, k - 1 - p + a)
+               for k, p, a in zip(kernel, pad_, adj_)]
+    out = jax.lax.conv_general_dilated(
+        data, w, window_strides=(1,) * nd, padding=padding,
+        lhs_dilation=stride, dimension_numbers=dn,
+        feature_group_count=num_group)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _deconv_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    nf = int(attrs.get("num_filter"))
+    ng = int(attrs.get("num_group", 1))
+    kernel = parse_tuple(attrs.get("kernel"))
+    nd = len(kernel)
+    stride = _tup(parse_tuple(attrs.get("stride", ())), nd, 1)
+    pad = _tup(parse_tuple(attrs.get("pad", ())), nd, 0)
+    adj = _tup(parse_tuple(attrs.get("adj", ())), nd, 0)
+    ins = list(in_shapes)
+    out = None
+    if data is not None:
+        ins[1] = (data[1], nf // ng) + kernel
+        spatial = tuple((i - 1) * s - 2 * p + k + a for i, k, s, p, a
+                        in zip(data[2:], kernel, stride, pad, adj))
+        out = (data[0], nf) + spatial
+    if len(ins) > 2:
+        ins[2] = (nf,)
+    return ins, [out], None
+
+
+# --------------------------------------------------------------------- Pooling
+def _pool_out_dim(i, k, s, p, convention):
+    if convention == "full":
+        return int(_np.ceil(float(i + 2 * p - k) / s)) + 1
+    return (i + 2 * p - k) // s + 1
+
+
+def _pool_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, [None], None
+    if attrs.get("global_pool", False):
+        return in_shapes, [data[:2] + (1,) * (len(data) - 2)], None
+    kernel = parse_tuple(attrs.get("kernel"))
+    nd = len(kernel)
+    stride = _tup(parse_tuple(attrs.get("stride", ())), nd, 1)
+    pad = _tup(parse_tuple(attrs.get("pad", ())), nd, 0)
+    conv = attrs.get("pooling_convention", "valid")
+    spatial = tuple(_pool_out_dim(i, k, s, p, conv)
+                    for i, k, s, p in zip(data[2:], kernel, stride, pad))
+    return in_shapes, [data[:2] + spatial], None
+
+
+@register("Pooling", aliases=("Pooling_v1",),
+          attr_types={"kernel": parse_tuple, "stride": parse_tuple,
+                      "pad": parse_tuple, "pool_type": parse_str,
+                      "global_pool": parse_bool, "pooling_convention": parse_str},
+          defaults={"stride": (), "pad": (), "pool_type": "max",
+                    "global_pool": False, "pooling_convention": "valid"},
+          infer_shape=_pool_infer)
+def _pooling(data, kernel=None, stride=(), pad=(), pool_type="max",
+             global_pool=False, pooling_convention="valid"):
+    """N-D pooling via XLA reduce_window (parity: pooling-inl.h / pool.h)."""
+    nd = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    else:
+        kernel = tuple(kernel)
+        stride = _tup(stride, nd, 1)
+        pad = _tup(pad, nd, 0)
+    # padding, possibly asymmetric for 'full' convention
+    pads = []
+    for i, k, s, p in zip(data.shape[2:], kernel, stride, pad):
+        out = _pool_out_dim(i, k, s, p, pooling_convention if not global_pool
+                            else "valid")
+        needed = (out - 1) * s + k - i - p
+        pads.append((p, max(needed, p)))
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = [(0, 0), (0, 0)] + pads
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
+            jnp.iinfo(data.dtype).min
+        return jax.lax.reduce_window(data, init, jax.lax.max, window, strides,
+                                     padding)
+    ssum = jax.lax.reduce_window(data, 0.0, jax.lax.add,
+                                 window, strides, padding)
+    if pool_type == "sum":
+        return ssum
+    if pool_type == "avg":
+        ones = jnp.ones(data.shape, data.dtype)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                    padding)
+        return ssum / cnt
+    raise MXNetError("unknown pool_type %s" % pool_type)
+
+
+# ------------------------------------------------------------------- BatchNorm
+def _bn_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    c = None if data is None else (data[1],)
+    ins = [data] + [c] * (len(in_shapes) - 1)
+    nout = 3 if attrs.get("output_mean_var", False) else 1
+    outs = [data] + ([c, c] if nout == 3 else [])
+    return ins, outs, [c, c]
+
+
+@register("BatchNorm", arg_names=("data", "gamma", "beta", "moving_mean",
+                                  "moving_var"),
+          aux_names=("moving_mean", "moving_var"),
+          num_outputs=lambda attrs: 3 if attrs.get("output_mean_var", False) else 1,
+          attr_types={"eps": parse_float, "momentum": parse_float,
+                      "fix_gamma": parse_bool, "use_global_stats": parse_bool,
+                      "output_mean_var": parse_bool},
+          defaults={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,
+                    "use_global_stats": False, "output_mean_var": False},
+          infer_shape=_bn_infer, train_aware=True)
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, is_train=False,
+                eps=1e-3, momentum=0.9, fix_gamma=True, use_global_stats=False,
+                output_mean_var=False):
+    """Batch normalization (parity: batch_norm-inl.h / cudnn_batch_norm).
+
+    Returns (out[, mean, var], new_moving_mean, new_moving_var); the trailing two
+    are auxiliary-state updates collected by the executor."""
+    axes = (0,) + tuple(range(2, data.ndim))
+    cshape = (1, -1) + (1,) * (data.ndim - 2)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if is_train and not use_global_stats:
+        mean = jnp.mean(data, axis=axes)
+        var = jnp.var(data, axis=axes)
+        new_mm = moving_mean * momentum + mean * (1 - momentum)
+        new_mv = moving_var * momentum + var * (1 - momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        mean = jax.lax.stop_gradient(mean)
+        var = jax.lax.stop_gradient(var)
+        new_mm, new_mv = moving_mean, moving_var
+    inv = jax.lax.rsqrt(var.reshape(cshape) + eps)
+    out = (data - mean.reshape(cshape)) * inv * g.reshape(cshape) \
+        + beta.reshape(cshape)
+    if output_mean_var:
+        return out, mean, var, new_mm, new_mv
+    return out, new_mm, new_mv
+
+
+@register("InstanceNorm", arg_names=("data", "gamma", "beta"),
+          attr_types={"eps": parse_float}, defaults={"eps": 1e-3},
+          infer_shape=lambda attrs, ins: (
+              [ins[0]] + [None if ins[0] is None else (ins[0][1],)] * 2,
+              [ins[0]], None))
+def _instance_norm(data, gamma, beta, eps=1e-3):
+    """(parity: instance_norm-inl.h)"""
+    axes = tuple(range(2, data.ndim))
+    cshape = (1, -1) + (1,) * (data.ndim - 2)
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    return (data - mean) * jax.lax.rsqrt(var + eps) * gamma.reshape(cshape) \
+        + beta.reshape(cshape)
+
+
+@register("L2Normalization", attr_types={"eps": parse_float, "mode": parse_str},
+          defaults={"eps": 1e-10, "mode": "instance"})
+def _l2_normalization(data, eps=1e-10, mode="instance"):
+    """(parity: l2_normalization-inl.h; modes instance/channel/spatial)"""
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, data.ndim))
+    else:
+        raise MXNetError("unknown mode %s" % mode)
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / norm
+
+
+@register("LRN", attr_types={"alpha": parse_float, "beta": parse_float,
+                             "knorm": parse_float, "nsize": parse_int},
+          defaults={"alpha": 1e-4, "beta": 0.75, "knorm": 2.0, "nsize": 5})
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response norm across channels (parity: lrn-inl.h)."""
+    sq = jnp.square(data)
+    half = nsize // 2
+    pads = [(0, 0)] * data.ndim
+    pads[1] = (half, half)
+    sq = jnp.pad(sq, pads)
+    window = [1] * data.ndim
+    window[1] = nsize
+    ssum = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window),
+                                 (1,) * data.ndim, [(0, 0)] * data.ndim)
+    return data / jnp.power(knorm + alpha * ssum / nsize, beta)
+
+
+# --------------------------------------------------------------------- Dropout
+@register("Dropout", attr_types={"p": parse_float}, defaults={"p": 0.5},
+          needs_rng=True, train_aware=True)
+def _dropout(data, rng=None, is_train=False, p=0.5):
+    """Inverted dropout (parity: dropout-inl.h)."""
+    if not is_train or p <= 0.0:
+        return data
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, data.shape)
+    return jnp.where(mask, data / keep, 0.0).astype(data.dtype)
+
+
+# ------------------------------------------------------------------ UpSampling
+@register("UpSampling",
+          arg_names=lambda attrs: ["arg%d" % i for i in range(
+              int(attrs.get("num_args", 1)))],
+          key_var_num_args="num_args",
+          attr_types={"scale": parse_int, "num_filter": parse_int,
+                      "sample_type": parse_str, "multi_input_mode": parse_str,
+                      "num_args": parse_int, "workspace": parse_int},
+          defaults={"scale": 1, "sample_type": "nearest",
+                    "multi_input_mode": "concat"})
+def _upsampling(*args, num_args=None, scale=1, num_filter=0,
+                sample_type="nearest", multi_input_mode="concat", workspace=None):
+    """(parity: upsampling-inl.h; nearest repeat / bilinear resize)"""
+    outs = []
+    data = args[0]
+    target = (data.shape[2] * scale, data.shape[3] * scale)
+    for x in args:
+        if sample_type == "nearest":
+            y = jnp.repeat(jnp.repeat(x, target[0] // x.shape[2], axis=2),
+                           target[1] // x.shape[3], axis=3)
+        else:
+            y = jax.image.resize(x, x.shape[:2] + target, method="bilinear")
+        outs.append(y)
+    if len(outs) == 1:
+        return outs[0]
+    if multi_input_mode == "sum":
+        out = outs[0]
+        for y in outs[1:]:
+            out = out + y
+        return out
+    return jnp.concatenate(outs, axis=1)
+
+
+# --------------------------------------------------------------------- softmax
+@register("softmax", attr_types={"axis": parse_int, "temperature": parse_float},
+          defaults={"axis": -1, "temperature": None})
+def _softmax(data, axis=-1, temperature=None):
+    x = data / temperature if temperature else data
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax", attr_types={"axis": parse_int, "temperature": parse_float},
+          defaults={"axis": -1, "temperature": None})
+def _log_softmax(data, axis=-1, temperature=None):
+    x = data / temperature if temperature else data
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("SoftmaxActivation", attr_types={"mode": parse_str},
+          defaults={"mode": "instance"})
+def _softmax_activation(data, mode="instance"):
+    """(parity: softmax_activation-inl.h)"""
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1),
+                          axis=-1).reshape(data.shape)

@@ -1,0 +1,69 @@
+"""Ordering ops (parity: reference src/operator/tensor/ordering_op.cc/-inl.h; the
+cub/mshadow sort kernels are replaced by XLA's sort/top_k)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, parse_bool, parse_int, parse_str
+
+
+def _topk_shapes(attrs, s):
+    axis = attrs.get("axis", -1)
+    k = int(attrs.get("k", 1))
+    ret_typ = attrs.get("ret_typ", "indices")
+    if s is None:
+        return None
+    ax = (axis if axis is not None else -1) % len(s)
+    out = list(s)
+    out[ax] = min(k, s[ax]) if k else s[ax]
+    return tuple(out)
+
+
+def _topk_infer(attrs, in_shapes):
+    out = _topk_shapes(attrs, in_shapes[0])
+    n = 2 if attrs.get("ret_typ", "indices") == "both" else 1
+    return in_shapes, [out] * n, None
+
+
+@register("topk",
+          num_outputs=lambda attrs: 2 if attrs.get("ret_typ", "indices") == "both" else 1,
+          attr_types={"axis": parse_int, "k": parse_int, "ret_typ": parse_str,
+                      "is_ascend": parse_bool},
+          defaults={"axis": -1, "k": 1, "ret_typ": "indices", "is_ascend": False},
+          infer_shape=_topk_infer)
+def _topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+    ax = (axis if axis is not None else -1) % data.ndim
+    x = jnp.moveaxis(data, ax, -1)
+    vals = jnp.sort(x, axis=-1)
+    idxs = jnp.argsort(x, axis=-1)
+    if not is_ascend:
+        vals = vals[..., ::-1]
+        idxs = idxs[..., ::-1]
+    k = k if k else data.shape[ax]
+    vals, idxs = vals[..., :k], idxs[..., :k]
+    vals = jnp.moveaxis(vals, -1, ax)
+    idxs = jnp.moveaxis(idxs, -1, ax).astype(data.dtype)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idxs
+    return idxs  # 'indices' (float, parity with MXNet ret dtype)
+
+
+@register("sort", attr_types={"axis": parse_int, "is_ascend": parse_bool},
+          defaults={"axis": -1, "is_ascend": True})
+def _sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis if axis is not None else -1)
+    return out
+
+
+@register("argsort", attr_types={"axis": parse_int, "is_ascend": parse_bool},
+          defaults={"axis": -1, "is_ascend": True})
+def _argsort(data, axis=-1, is_ascend=True):
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis if axis is not None else -1)
+    return out.astype(data.dtype)

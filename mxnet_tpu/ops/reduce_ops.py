@@ -1,0 +1,126 @@
+"""Reduction and broadcasting ops (parity: reference
+src/operator/tensor/broadcast_reduce_op_value.cc / _index.cc,
+broadcast_reduce-inl.h).  XLA's reduce/window machinery replaces the hand-written
+reduce kernels."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as _np
+
+from .registry import register, parse_bool, parse_int, parse_tuple
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None or axis == ():
+        ax = tuple(range(ndim))
+    elif isinstance(axis, int):
+        ax = (axis % ndim,)
+    else:
+        ax = tuple(a % ndim for a in axis)
+    if exclude:
+        ax = tuple(i for i in range(ndim) if i not in ax)
+    return ax
+
+
+def _reduce_infer(attrs, in_shapes):
+    s = in_shapes[0]
+    if s is None:
+        return in_shapes, [None], None
+    ax = _norm_axis(attrs.get("axis"), len(s), attrs.get("exclude", False))
+    if attrs.get("keepdims", False):
+        out = tuple(1 if i in ax else d for i, d in enumerate(s))
+    else:
+        out = tuple(d for i, d in enumerate(s) if i not in ax)
+    return in_shapes, [out], None
+
+
+_REDUCE_ATTRS = dict(
+    attr_types={"axis": parse_tuple, "keepdims": parse_bool, "exclude": parse_bool},
+    defaults={"axis": None, "keepdims": False, "exclude": False},
+    infer_shape=_reduce_infer)
+
+
+def _make_reduce(jfn):
+    def f(data, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis, data.ndim, exclude)
+        return jfn(data, axis=ax, keepdims=keepdims)
+    return f
+
+
+register("sum", aliases=("sum_axis",), **_REDUCE_ATTRS)(_make_reduce(jnp.sum))
+register("mean", **_REDUCE_ATTRS)(_make_reduce(jnp.mean))
+register("prod", **_REDUCE_ATTRS)(_make_reduce(jnp.prod))
+register("nansum", **_REDUCE_ATTRS)(_make_reduce(jnp.nansum))
+register("nanprod", **_REDUCE_ATTRS)(_make_reduce(jnp.nanprod))
+register("max", aliases=("max_axis",), **_REDUCE_ATTRS)(_make_reduce(jnp.max))
+register("min", aliases=("min_axis",), **_REDUCE_ATTRS)(_make_reduce(jnp.min))
+
+
+@register("norm")
+def _norm(data):
+    """Frobenius norm of the whole array (parity: broadcast_reduce_op_value.cc norm)."""
+    return jnp.sqrt(jnp.sum(jnp.square(data))).reshape((1,))
+
+
+def _arg_infer(attrs, in_shapes):
+    s = in_shapes[0]
+    if s is None:
+        return in_shapes, [None], None
+    axis = attrs.get("axis")
+    keepdims = attrs.get("keepdims", False)
+    if axis is None:
+        out = (1,) if not keepdims else tuple(1 for _ in s)
+    else:
+        a = axis % len(s)
+        out = tuple(1 if i == a else d for i, d in enumerate(s)) if keepdims \
+            else tuple(d for i, d in enumerate(s) if i != a)
+        if out == ():
+            out = (1,)
+    return in_shapes, [out], None
+
+
+def _make_arg(jfn):
+    def f(data, axis=None, keepdims=False):
+        # MXNet returns indices in the input's (real) dtype
+        out = jfn(data, axis=axis, keepdims=keepdims).astype(data.dtype)
+        if axis is None and not keepdims:
+            out = out.reshape((1,))
+        elif axis is not None and out.ndim == 0:
+            out = out.reshape((1,))
+        return out
+    return f
+
+
+_ARG_ATTRS = dict(attr_types={"axis": parse_int, "keepdims": parse_bool},
+                  defaults={"axis": None, "keepdims": False},
+                  infer_shape=_arg_infer)
+register("argmax", **_ARG_ATTRS)(_make_arg(jnp.argmax))
+register("argmin", **_ARG_ATTRS)(_make_arg(jnp.argmin))
+
+
+@register("argmax_channel")
+def _argmax_channel(data):
+    """argmax over axis 1 (parity: broadcast_reduce_op_index.cc argmax_channel)."""
+    return jnp.argmax(data, axis=1).astype(data.dtype)
+
+
+@register("broadcast_to", attr_types={"shape": parse_tuple}, defaults={"shape": ()},
+          infer_shape=lambda attrs, ins: (
+              ins, [None if ins[0] is None else tuple(
+                  t if t != 0 else s for s, t in zip(ins[0], parse_tuple(attrs.get("shape", ()))))],
+              None))
+def _broadcast_to(data, shape=()):
+    tgt = tuple(t if t != 0 else s for s, t in zip(data.shape, shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",),
+          attr_types={"axis": parse_tuple, "size": parse_tuple},
+          defaults={"axis": (), "size": ()})
+def _broadcast_axis(data, axis=(), size=()):
+    ax = axis if isinstance(axis, (tuple, list)) else (axis,)
+    sz = size if isinstance(size, (tuple, list)) else (size,)
+    tgt = list(data.shape)
+    for a, s in zip(ax, sz):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))

@@ -1,0 +1,281 @@
+"""Operator registry — the TPU-native replacement for NNVM's Op registry +
+FCompute dispatch (reference: include/mxnet/op_attr_types.h, src/c_api/c_api_ndarray.cc
+MXImperativeInvoke, nnvm Op attrs).
+
+Design (tpu-first): an operator is a *pure JAX function* plus metadata.  Imperative
+calls jit the function once per (attrs, is_train) and let XLA cache per input shape;
+symbolic execution composes the same functions into one traced computation that XLA
+fuses and schedules — there is no per-op kernel dispatch, no PlanMemory, no cached-op
+engine path, because the XLA compiler owns scheduling/memory on TPU.
+
+Gradient metadata (NNVM FGradient) is unnecessary: backward comes from JAX autodiff of
+the composed forward; ops with non-autodiff semantics (SoftmaxOutput & friends) embed a
+``jax.custom_vjp``.  Shape/type inference (FInferShape/FInferType) defaults to
+``jax.eval_shape`` and is overridden per-op only where MXNet requires *bidirectional*
+inference (parameter-bearing ops deduce weight shapes from data).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+
+import numpy as _np
+
+from ..base import MXNetError, Registry
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "OPS", "attr_key",
+           "parse_tuple", "parse_int", "parse_float", "parse_bool", "parse_str",
+           "parse_dtype", "normalize_attrs", "eval_shape_infer"]
+
+OPS = Registry("operator")
+
+
+# ---------------------------------------------------------------- attr parsing
+def parse_tuple(v):
+    if v is None or isinstance(v, tuple):
+        return v
+    if isinstance(v, list):
+        return tuple(v)
+    if isinstance(v, (int, float)):
+        return (int(v),)
+    v = v.strip()
+    out = ast.literal_eval(v)
+    if isinstance(out, (int, float)):
+        return (int(out),)
+    return tuple(int(x) for x in out)
+
+
+def parse_int(v):
+    if v is None:
+        return None
+    if isinstance(v, str) and v in ("None", ""):
+        return None
+    return int(v)
+
+
+def parse_float(v):
+    return None if v is None else float(v)
+
+
+def parse_bool(v):
+    if isinstance(v, str):
+        return v not in ("0", "False", "false", "")
+    return bool(v)
+
+
+def parse_str(v):
+    return None if v is None else str(v)
+
+
+_DTYPES = {"float32": _np.float32, "float64": _np.float64, "float16": _np.float16,
+           "uint8": _np.uint8, "int32": _np.int32, "int8": _np.int8,
+           "int64": _np.int64}
+
+
+def parse_dtype(v):
+    """Accept numpy dtypes, jax dtypes, and string names (incl. bfloat16)."""
+    if v is None:
+        return None
+    if isinstance(v, str):
+        if v == "bfloat16":
+            import jax.numpy as jnp
+            return jnp.bfloat16
+        return _np.dtype(_DTYPES[v]) if v in _DTYPES else _np.dtype(v)
+    return v
+
+
+def dtype_name(dt):
+    return _np.dtype(dt).name if not repr(dt).endswith("bfloat16'>") else "bfloat16"
+
+
+class OpDef(object):
+    """One registered operator.
+
+    Parameters
+    ----------
+    name : canonical op name (MXNet spelling, e.g. 'FullyConnected', 'broadcast_add')
+    fn : fn(*inputs, rng=None, is_train=False, **attrs) -> jnp array | tuple.
+        When ``num_aux`` > 0 the tuple carries ``num_outputs`` visible outputs
+        followed by ``num_aux`` updated auxiliary-state arrays.
+    arg_names : list of input names, or callable(attrs)->list (for variadic ops)
+    aux_names : names of auxiliary-state inputs (BatchNorm moving stats); these are
+        *trailing* entries of arg_names
+    attr_types : dict attr -> parser used for defaults and JSON round-trips
+    infer_shape : optional bidirectional callable(attrs, in_shapes)->(in, out, aux)
+        where unknown entries are None; default uses jax.eval_shape (forward-only)
+    infer_type : optional callable(attrs, in_dtypes)->(in, out, aux)
+    needs_rng / train_aware : whether fn takes rng= / is_train=
+    key_var_num_args : attr naming the input count for variadic ops ('num_args')
+    aliases : extra registered names
+    """
+
+    def __init__(self, name, fn, arg_names=("data",), aux_names=(), num_outputs=1,
+                 attr_types=None, defaults=None, infer_shape=None, infer_type=None,
+                 needs_rng=False, train_aware=False, key_var_num_args=None,
+                 aliases=(), hidden=False, doc=None):
+        self.name = name
+        self.fn = fn
+        self._arg_names = arg_names
+        self.aux_names = tuple(aux_names)
+        self.num_aux = len(self.aux_names)
+        self._num_outputs = num_outputs
+        self.attr_types = dict(attr_types or {})
+        self.defaults = dict(defaults or {})
+        self._infer_shape = infer_shape
+        self._infer_type = infer_type
+        self.needs_rng = needs_rng
+        self.train_aware = train_aware
+        self.key_var_num_args = key_var_num_args
+        self.aliases = tuple(aliases)
+        self.hidden = hidden
+        self.doc = doc or (fn.__doc__ if fn is not None else None)
+
+    # ------------------------------------------------------------------ meta
+    def arg_names_for(self, attrs):
+        names = self._arg_names(attrs) if callable(self._arg_names) else self._arg_names
+        return list(names)
+
+    def num_outputs_for(self, attrs):
+        no = self._num_outputs
+        return no(attrs) if callable(no) else no
+
+    def normalize_attrs(self, attrs):
+        """Apply defaults and parse string-valued attrs (JSON round-trip)."""
+        out = dict(self.defaults)
+        for k, v in attrs.items():
+            if k in self.attr_types and (isinstance(v, str) or v is None
+                                         or not isinstance(v, str)):
+                try:
+                    out[k] = self.attr_types[k](v)
+                except (ValueError, SyntaxError, KeyError, TypeError):
+                    out[k] = v
+            else:
+                out[k] = v
+        return out
+
+    # ---------------------------------------------------------------- compute
+    def make_callable(self, attrs, is_train):
+        """A positional-args-only closure over normalized attrs (jit-friendly)."""
+        fn = self.fn
+        kw = {}
+        if self.train_aware:
+            kw["is_train"] = is_train
+        if self.needs_rng:
+            def call(rng, *args):
+                return fn(*args, rng=rng, **kw, **attrs)
+        else:
+            def call(*args):
+                return fn(*args, **kw, **attrs)
+        return call
+
+    # -------------------------------------------------------------- inference
+    def infer_shape(self, attrs, in_shapes):
+        if self._infer_shape is not None:
+            return self._infer_shape(attrs, list(in_shapes))
+        return eval_shape_infer(self, attrs, in_shapes, None)[:2] + (None,)
+
+    def infer_type(self, attrs, in_dtypes):
+        if self._infer_type is not None:
+            return self._infer_type(attrs, list(in_dtypes))
+        known = [d for d in in_dtypes if d is not None]
+        d = known[0] if known else _np.float32
+        n_in = len(in_dtypes)
+        return [d] * n_in, [d] * self.num_outputs_for(attrs), [d] * self.num_aux
+
+
+def eval_shape_infer(op, attrs, in_shapes, in_dtypes):
+    """Forward-only inference via jax.eval_shape (XLA's own shape rules)."""
+    import jax
+    import jax.numpy as jnp
+
+    if any(s is None for s in in_shapes):
+        n_out = op.num_outputs_for(attrs)
+        return list(in_shapes), [None] * n_out, [None] * op.num_aux
+    dts = in_dtypes or [_np.float32] * len(in_shapes)
+    dts = [d if d is not None else _np.float32 for d in dts]
+    call = op.make_callable(op.normalize_attrs(attrs), is_train=True)
+    specs = [jax.ShapeDtypeStruct(tuple(int(x) for x in s), d)
+             for s, d in zip(in_shapes, dts)]
+    if op.needs_rng:
+        key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        out = jax.eval_shape(call, key, *specs)
+    else:
+        out = jax.eval_shape(call, *specs)
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    shapes = [tuple(o.shape) for o in out]
+    n_out = op.num_outputs_for(attrs)
+    return (list(in_shapes), shapes[:n_out],
+            shapes[n_out:n_out + op.num_aux] if op.num_aux else None)
+
+
+def register(name, **kwargs):
+    """Decorator: register ``fn`` as operator ``name``."""
+
+    def deco(fn):
+        op = OpDef(name, fn, **kwargs)
+        OPS.register(name, op)
+        for al in op.aliases:
+            OPS.register(al, op)
+        return fn
+
+    return deco
+
+
+def get_op(name):
+    return OPS.get(name)
+
+
+def list_ops():
+    return OPS.list_names()
+
+
+def attr_key(attrs):
+    """Hashable canonical key for an attr dict."""
+    def freeze(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(freeze(x) for x in v)
+        if isinstance(v, dict):
+            return tuple(sorted((k, freeze(x)) for k, x in v.items()))
+        if isinstance(v, _np.dtype):
+            return v.name
+        if isinstance(v, type):
+            return v.__name__
+        return v
+
+    return tuple(sorted((k, freeze(v)) for k, v in attrs.items()))
+
+
+# ------------------------------------------------------------- imperative JIT
+_JIT_CACHE = {}
+
+
+def jitted(op, attrs, is_train=False):
+    """Return the jit-compiled callable for (op, attrs, is_train)."""
+    import jax
+
+    key = (op.name, attr_key(attrs), bool(is_train))
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(op.make_callable(attrs, is_train))
+        _JIT_CACHE[key] = fn
+    return fn
+
+
+def imperative_invoke(op_name, inputs, attrs=None, is_train=False, rng=None):
+    """Run one op eagerly on jax arrays (parity: MXImperativeInvoke,
+    src/c_api/c_api_ndarray.cc:323).  Returns a tuple of jax arrays
+    (visible outputs + aux updates)."""
+    op = get_op(op_name) if isinstance(op_name, str) else op_name
+    attrs = op.normalize_attrs(attrs or {})
+    fn = jitted(op, attrs, is_train)
+    if op.needs_rng:
+        if rng is None:
+            from .. import random as _random
+            rng = _random.next_key()
+        out = fn(rng, *inputs)
+    else:
+        out = fn(*inputs)
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    return tuple(out), op

@@ -1,0 +1,37 @@
+"""Random sampling ops (parity: reference src/operator/tensor/sample_op.cc; the
+kRandom resource of src/resource.cc becomes a splittable JAX PRNG key threaded by
+the registry)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .registry import register, parse_dtype, parse_float, parse_tuple
+
+
+def _sample_infer(attrs, in_shapes):
+    return [], [tuple(parse_tuple(attrs.get("shape", ())) or ())], None
+
+
+_COMMON = dict(arg_names=(), needs_rng=True,
+               infer_shape=_sample_infer,
+               infer_type=lambda attrs, in_dt: ([], [attrs.get("dtype") or _np.float32], []))
+
+
+@register("_random_uniform", aliases=("uniform", "_sample_uniform"),
+          attr_types={"low": parse_float, "high": parse_float,
+                      "shape": parse_tuple, "dtype": parse_dtype},
+          defaults={"low": 0.0, "high": 1.0, "shape": (), "dtype": _np.float32},
+          **_COMMON)
+def _uniform(rng=None, low=0.0, high=1.0, shape=(), dtype=_np.float32):
+    return jax.random.uniform(rng, shape, jnp.float32, low, high).astype(dtype)
+
+
+@register("_random_normal", aliases=("normal", "_sample_normal"),
+          attr_types={"loc": parse_float, "scale": parse_float,
+                      "shape": parse_tuple, "dtype": parse_dtype},
+          defaults={"loc": 0.0, "scale": 1.0, "shape": (), "dtype": _np.float32},
+          **_COMMON)
+def _normal(rng=None, loc=0.0, scale=1.0, shape=(), dtype=_np.float32):
+    return (jax.random.normal(rng, shape, jnp.float32) * scale + loc).astype(dtype)

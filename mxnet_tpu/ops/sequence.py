@@ -1,0 +1,61 @@
+"""Sequence ops (parity: reference src/operator/sequence_last.cc,
+sequence_mask.cc, sequence_reverse.cc, src/operator/sequence_op_common.h).
+
+Layout convention matches MXNet: time-major (T, N, ...) with optional
+``sequence_length`` (N,) input gated by ``use_sequence_length``."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, parse_bool, parse_float
+
+
+def _seq_args(attrs):
+    if attrs.get("use_sequence_length", False):
+        return ["data", "sequence_length"]
+    return ["data"]
+
+
+_SEQ = dict(arg_names=_seq_args,
+            attr_types={"use_sequence_length": parse_bool},
+            defaults={"use_sequence_length": False})
+
+
+@register("SequenceLast",
+          infer_shape=lambda attrs, ins: (
+              ins, [None if ins[0] is None else tuple(ins[0][1:])], None),
+          **_SEQ)
+def _sequence_last(data, sequence_length=None, use_sequence_length=False):
+    if not use_sequence_length:
+        return data[-1]
+    idx = (sequence_length.astype(jnp.int32) - 1)  # (N,)
+    return jnp.take_along_axis(
+        data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0)[0]
+
+
+@register("SequenceMask",
+          arg_names=_seq_args,
+          attr_types={"use_sequence_length": parse_bool, "value": parse_float},
+          defaults={"use_sequence_length": False, "value": 0.0})
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                   value=0.0):
+    if not use_sequence_length:
+        return data
+    T = data.shape[0]
+    steps = jnp.arange(T).reshape((T, 1) + (1,) * (data.ndim - 2))
+    mask = steps < sequence_length.astype(jnp.int32).reshape(
+        (1, -1) + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, value).astype(data.dtype)
+
+
+@register("SequenceReverse", **_SEQ)
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False):
+    if not use_sequence_length:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    lens = sequence_length.astype(jnp.int32)  # (N,)
+    t = jnp.arange(T).reshape(-1, 1)
+    src = jnp.where(t < lens.reshape(1, -1), lens.reshape(1, -1) - 1 - t, t)
+    return jnp.take_along_axis(
+        data, src.reshape((T, -1) + (1,) * (data.ndim - 2)), axis=0)

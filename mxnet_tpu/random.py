@@ -1,0 +1,39 @@
+"""RNG state (parity: reference python/mxnet/random.py, src/resource.cc kRandom).
+
+TPU-first: a single splittable JAX PRNG key replaces per-device mshadow generators.
+Every imperative sample op and every executor forward draws a fresh split, so results
+are reproducible after ``mx.random.seed(s)`` regardless of async dispatch order —
+stronger than the reference, whose parallel sampling is nondeterministic.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed", "next_key"]
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _get():
+    key = getattr(_state, "key", None)
+    if key is None:
+        import jax
+        key = jax.random.PRNGKey(_DEFAULT_SEED)
+        _state.key = key
+    return _state.key
+
+
+def seed(seed_state):
+    """Seed the global generator (parity: mx.random.seed, MXRandomSeed)."""
+    import jax
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Draw a fresh subkey from the global stream."""
+    import jax
+    key = _get()
+    key, sub = jax.random.split(key)
+    _state.key = key
+    return sub

@@ -1,0 +1,9 @@
+"""Test harness: run everything on an 8-device virtual CPU mesh so multi-chip
+sharding semantics are exercised without TPU hardware (the driver's
+dryrun_multichip uses the same mechanism)."""
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      (os.environ.get("XLA_FLAGS", "") +
+                       " --xla_force_host_platform_device_count=8").strip())
+os.environ["JAX_PLATFORMS"] = "cpu"

@@ -1,0 +1,222 @@
+"""NDArray tests (parity model: reference tests/python/unittest/test_ndarray.py —
+same behaviors checked, written fresh against numpy)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def rand(*shape):
+    return np.random.uniform(-10, 10, shape).astype(np.float32)
+
+
+def test_creation():
+    a = mx.nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    np.testing.assert_allclose(a.asnumpy(), np.zeros((3, 4)))
+    b = mx.nd.ones((2, 3), dtype=np.int32)
+    assert b.dtype == np.int32
+    np.testing.assert_allclose(b.asnumpy(), np.ones((2, 3)))
+    c = mx.nd.full((2, 2), 3.5)
+    np.testing.assert_allclose(c.asnumpy(), np.full((2, 2), 3.5))
+    d = mx.nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    assert d.size == 4
+    e = mx.nd.arange(0, 10, 2)
+    np.testing.assert_allclose(e.asnumpy(), np.arange(0, 10, 2))
+    f = mx.nd.arange(3, repeat=2)
+    np.testing.assert_allclose(f.asnumpy(), [0, 0, 1, 1, 2, 2])
+
+
+def test_elementwise():
+    x, y = rand(3, 4), rand(3, 4)
+    a, b = mx.nd.array(x), mx.nd.array(y)
+    np.testing.assert_allclose((a + b).asnumpy(), x + y, rtol=1e-5)
+    np.testing.assert_allclose((a - b).asnumpy(), x - y, rtol=1e-5)
+    np.testing.assert_allclose((a * b).asnumpy(), x * y, rtol=1e-5)
+    np.testing.assert_allclose((a / b).asnumpy(), x / y, rtol=1e-4)
+    np.testing.assert_allclose((a + 2).asnumpy(), x + 2, rtol=1e-5)
+    np.testing.assert_allclose((2 - a).asnumpy(), 2 - x, rtol=1e-5)
+    np.testing.assert_allclose((2 / a).asnumpy(), 2 / x, rtol=1e-4)
+    np.testing.assert_allclose((-a).asnumpy(), -x, rtol=1e-5)
+    np.testing.assert_allclose((a > b).asnumpy(), (x > y).astype(np.float32))
+    np.testing.assert_allclose((a == b).asnumpy(), (x == y).astype(np.float32))
+
+
+def test_inplace():
+    x = rand(3, 4)
+    a = mx.nd.array(x)
+    a += 1
+    np.testing.assert_allclose(a.asnumpy(), x + 1, rtol=1e-5)
+    a *= 2
+    np.testing.assert_allclose(a.asnumpy(), (x + 1) * 2, rtol=1e-5)
+
+
+def test_setitem_getitem_views():
+    x = mx.nd.zeros((2, 3))
+    x[:] = 1
+    np.testing.assert_allclose(x.asnumpy(), np.ones((2, 3)))
+    x[:, 1:2] = 2
+    np.testing.assert_allclose(x.asnumpy(), [[1, 2, 1], [1, 2, 1]])
+    # slice views share memory (parity: reference ndarray __getitem__ doc)
+    y = x[0:1]
+    y[:] = 5
+    np.testing.assert_allclose(x.asnumpy(), [[5, 5, 5], [1, 2, 1]])
+    row = x[1]
+    assert row.shape == (3,)
+    np.testing.assert_allclose(row.asnumpy(), [1, 2, 1])
+    row[:] = 7
+    np.testing.assert_allclose(x.asnumpy(), [[5, 5, 5], [7, 7, 7]])
+
+
+def test_reshape_view():
+    a = mx.nd.array(np.arange(6).astype(np.float32))
+    b = a.reshape((2, 3))
+    assert b.shape == (2, 3)
+    b[:] = 0
+    np.testing.assert_allclose(a.asnumpy(), np.zeros(6))
+    c = a.reshape((3, -1))
+    assert c.shape == (3, 2)
+    d = mx.nd.array(rand(2, 3, 4)).reshape((0, -1))
+    assert d.shape == (2, 12)
+
+
+def test_copy_and_context():
+    x = rand(3, 3)
+    a = mx.nd.array(x)
+    b = a.copy()
+    b[:] = 0
+    np.testing.assert_allclose(a.asnumpy(), x, rtol=1e-6)
+    c = mx.nd.zeros((3, 3))
+    a.copyto(c)
+    np.testing.assert_allclose(c.asnumpy(), x, rtol=1e-6)
+    d = a.as_in_context(mx.cpu(1))
+    assert d.context == mx.cpu(1)
+    np.testing.assert_allclose(d.asnumpy(), x, rtol=1e-6)
+    assert a.as_in_context(a.context) is a
+
+
+def test_astype():
+    a = mx.nd.array(np.array([1.6, 2.2]))
+    b = a.astype(np.int32)
+    assert b.dtype == np.int32
+    np.testing.assert_allclose(b.asnumpy(), [1, 2])
+
+
+def test_unary_funcs():
+    x = np.abs(rand(3, 4)) + 0.1
+    a = mx.nd.array(x)
+    np.testing.assert_allclose(mx.nd.sqrt(a).asnumpy(), np.sqrt(x), rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.exp(mx.nd.array(x * 0.1)).asnumpy(),
+                               np.exp(x * 0.1), rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.log(a).asnumpy(), np.log(x), rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.square(a).asnumpy(), x ** 2, rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.abs(mx.nd.array(-x)).asnumpy(), x,
+                               rtol=1e-5)
+    np.testing.assert_allclose(mx.nd.sign(mx.nd.array(x - x.mean())).asnumpy(),
+                               np.sign(x - x.mean()))
+    np.testing.assert_allclose(mx.nd.relu(mx.nd.array(x - 5)).asnumpy(),
+                               np.maximum(x - 5, 0), rtol=1e-5)
+
+
+def test_dot():
+    x, y = rand(4, 5), rand(5, 6)
+    a, b = mx.nd.array(x), mx.nd.array(y)
+    np.testing.assert_allclose(mx.nd.dot(a, b).asnumpy(), x.dot(y), rtol=1e-4)
+    np.testing.assert_allclose(
+        mx.nd.dot(a, mx.nd.array(y.T), transpose_b=True).asnumpy(), x.dot(y),
+        rtol=1e-4)
+
+
+def test_reduce():
+    x = rand(3, 4, 5)
+    a = mx.nd.array(x)
+    np.testing.assert_allclose(mx.nd.sum(a).asnumpy(), x.sum(), rtol=1e-4)
+    np.testing.assert_allclose(mx.nd.sum(a, axis=1).asnumpy(), x.sum(1),
+                               rtol=1e-4)
+    np.testing.assert_allclose(mx.nd.max(a, axis=(0, 2)).asnumpy(),
+                               x.max((0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(
+        mx.nd.sum(a, axis=1, keepdims=True).asnumpy(), x.sum(1, keepdims=True),
+        rtol=1e-4)
+    np.testing.assert_allclose(mx.nd.argmax(a, axis=1).asnumpy(),
+                               np.argmax(x, 1))
+
+
+def test_broadcast_ops():
+    x, y = rand(3, 1), rand(1, 4)
+    a, b = mx.nd.array(x), mx.nd.array(y)
+    np.testing.assert_allclose(mx.nd.broadcast_add(a, b).asnumpy(), x + y,
+                               rtol=1e-5)
+    np.testing.assert_allclose((a * b).asnumpy(), x * y, rtol=1e-5)
+    c = mx.nd.array(x).broadcast_to((3, 4))
+    np.testing.assert_allclose(c.asnumpy(), np.broadcast_to(x, (3, 4)))
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.params")
+    a, b = mx.nd.array(rand(3, 4)), mx.nd.array(rand(5,))
+    mx.nd.save(fname, {"a": a, "b": b})
+    d = mx.nd.load(fname)
+    np.testing.assert_allclose(d["a"].asnumpy(), a.asnumpy())
+    np.testing.assert_allclose(d["b"].asnumpy(), b.asnumpy())
+    mx.nd.save(fname, [a, b])
+    lst = mx.nd.load(fname)
+    assert len(lst) == 2
+    np.testing.assert_allclose(lst[1].asnumpy(), b.asnumpy())
+
+
+def test_random():
+    mx.random.seed(7)
+    a = mx.nd.uniform(low=0, high=1, shape=(1000,))
+    mx.random.seed(7)
+    b = mx.nd.uniform(low=0, high=1, shape=(1000,))
+    np.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+    assert 0.4 < a.asnumpy().mean() < 0.6
+    n = mx.nd.normal(loc=2.0, scale=0.5, shape=(5000,))
+    assert abs(n.asnumpy().mean() - 2.0) < 0.1
+    assert abs(n.asnumpy().std() - 0.5) < 0.1
+
+
+def test_slicing_ops():
+    x = rand(4, 6)
+    a = mx.nd.array(x)
+    np.testing.assert_allclose(
+        mx.nd.slice_axis(a, axis=1, begin=1, end=4).asnumpy(), x[:, 1:4],
+        rtol=1e-6)
+    np.testing.assert_allclose(mx.nd.flip(a, axis=1).asnumpy(), x[:, ::-1],
+                               rtol=1e-6)
+    np.testing.assert_allclose(mx.nd.transpose(a).asnumpy(), x.T, rtol=1e-6)
+    sp = mx.nd.split(a, num_outputs=2, axis=1)
+    np.testing.assert_allclose(sp[0].asnumpy(), x[:, :3], rtol=1e-6)
+    cc = mx.nd.concat(mx.nd.array(x), mx.nd.array(x), dim=0)
+    np.testing.assert_allclose(cc.asnumpy(), np.concatenate([x, x], 0))
+
+
+def test_scalar_and_len():
+    a = mx.nd.array([42.0])
+    assert a.asscalar() == 42.0
+    assert len(mx.nd.zeros((5, 2))) == 5
+    with pytest.raises(mx.MXNetError):
+        bool(mx.nd.zeros((2,)))
+
+
+def test_take_onehot():
+    w = rand(10, 4)
+    idx = np.array([1, 3, 7], dtype=np.float32)
+    out = mx.nd.take(mx.nd.array(w), mx.nd.array(idx))
+    np.testing.assert_allclose(out.asnumpy(), w[[1, 3, 7]], rtol=1e-6)
+    oh = mx.nd.one_hot(mx.nd.array(idx), depth=10)
+    assert oh.shape == (3, 10)
+    assert oh.asnumpy()[1, 3] == 1.0
+
+
+def test_topk_sort():
+    x = rand(5, 10)
+    a = mx.nd.array(x)
+    v = mx.nd.topk(a, k=3, ret_typ="value")
+    np.testing.assert_allclose(v.asnumpy(), np.sort(x, 1)[:, ::-1][:, :3],
+                               rtol=1e-6)
+    s = mx.nd.sort(a, axis=1)
+    np.testing.assert_allclose(s.asnumpy(), np.sort(x, 1), rtol=1e-6)
