@@ -11,5 +11,12 @@ from . import ndarray
 from . import ndarray as nd
 from . import random
 from . import ops
+from . import symbol
+from . import symbol as sym
+from .symbol import Variable, Group
+from . import executor
+from .executor import Executor
+from .attribute import AttrScope
+from . import name
 
 __version__ = "0.1.0"

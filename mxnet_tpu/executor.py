@@ -1,0 +1,481 @@
+"""Executor — lowers a Symbol graph to XLA computations (parity: reference
+include/mxnet/executor.h, src/executor/graph_executor.cc, python/mxnet/executor.py).
+
+TPU-first replacement for the GraphExecutor pipeline (SURVEY.md §2.4):
+- InitFullGraph/Gradient pass            → jax.vjp over the traced forward
+- PlanMemory / InitDataEntryMemory       → XLA buffer assignment
+- InitCachedOps / bulk exec segments     → one jit-compiled computation per
+                                           (graph, shapes, is_train) — the whole
+                                           graph IS one "segment"
+- AttachOpExecs / dispatch               → tracing the registered jax op functions
+- kWriteTo/kAddTo grad_req               → functional grads written or accumulated
+                                           into the bound grad NDArrays
+- group2ctx + _CrossDeviceCopy           → eager multi-device walk with device_put
+                                           at ctx_group boundaries (model
+                                           parallelism without SPMD; the sharded
+                                           path lives in mxnet_tpu.parallel)
+
+Training calls the *fused* forward+backward computation so XLA sees the whole step
+and shares subexpressions (no double forward).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError, string_types
+from .context import Context, current_context
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import random as _random
+
+__all__ = ["Executor"]
+
+
+def _node_uid(node, uid_map):
+    u = uid_map.get(id(node))
+    if u is None:
+        u = len(uid_map)
+        uid_map[id(node)] = u
+    return u
+
+
+class _Lowered(object):
+    """The pure-functional form of a symbol graph."""
+
+    def __init__(self, symbol):
+        from .symbol import _topo
+        self.symbol = symbol
+        self.order = _topo([n for n, _ in symbol._outputs])
+        self.uid = {}
+        for n in self.order:
+            _node_uid(n, self.uid)
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.out_keys = [(id(n), i) for n, i in symbol._outputs]
+
+    def run(self, arg_vals, aux_vals, rng, is_train):
+        """Trace the graph: dict name->array in, (outputs, aux_updates) out."""
+        import jax
+        values = {}
+        aux_updates = {}
+        for node in self.order:
+            if node.is_var:
+                if node.name in arg_vals:
+                    values[(id(node), 0)] = arg_vals[node.name]
+                elif node.name in aux_vals:
+                    values[(id(node), 0)] = aux_vals[node.name]
+                else:
+                    raise MXNetError("unbound variable %s" % node.name)
+                continue
+            ins = [values[(id(c), i)] for c, i in node.inputs]
+            call = node.op.make_callable(node.params, is_train)
+            if node.op.needs_rng:
+                sub = jax.random.fold_in(rng, _node_uid(node, self.uid))
+                out = call(sub, *ins)
+            else:
+                out = call(*ins)
+            if not isinstance(out, (tuple, list)):
+                out = (out,)
+            n_vis = node.op.num_outputs_for(node.params)
+            for i in range(n_vis):
+                values[(id(node), i)] = out[i]
+            if node.op.num_aux:
+                names = node.op.arg_names_for(node.params)
+                aux_pos = [i for i, nm in enumerate(names)
+                           if nm in node.op.aux_names]
+                for k, pos in enumerate(aux_pos):
+                    child = node.inputs[pos][0]
+                    if child.is_var and is_train:
+                        aux_updates[child.name] = out[n_vis + k]
+        outputs = [values[k] for k in self.out_keys]
+        return outputs, aux_updates
+
+
+class Executor(object):
+    """Bound computation (parity: mx.executor.Executor)."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None, shared_exec=None):
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+        self._group2ctx = dict(group2ctx or {})
+        self._low = _Lowered(symbol)
+        self.arg_names = self._low.arg_names
+        self.aux_names = self._low.aux_names
+
+        self.arg_dict = self._dictify(args, self.arg_names, "args")
+        self.aux_dict = self._dictify(aux_states, self.aux_names, "aux_states",
+                                      allow_none=True)
+        # grad request per arg
+        if isinstance(grad_req, string_types):
+            self.grad_req = {n: grad_req for n in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(self.arg_names, grad_req))
+        else:
+            self.grad_req = {n: grad_req.get(n, "null") for n in self.arg_names}
+        self.grad_dict = self._dictify(args_grad, self.arg_names, "args_grad",
+                                       allow_none=True, partial=True)
+        for n, req in self.grad_req.items():
+            if req == "null":
+                self.grad_dict.pop(n, None)
+
+        # pre-allocate output NDArrays (in-place updated on every forward,
+        # parity: GraphExecutor output arrays)
+        shapes = {n: a.shape for n, a in self.arg_dict.items()}
+        _, out_shapes, _ = symbol.infer_shape_partial(**shapes)
+        types = {n: a.dtype for n, a in self.arg_dict.items()
+                 if -1 not in a.shape}
+        self._output_nds = []
+        for s in out_shapes:
+            self._output_nds.append(nd.zeros(s if s else (1,), ctx=self._ctx))
+        self._jit_cache = {}
+        self._monitor_cb = None
+        self._cached_grads = None
+        self._multi_device = self._detect_multi_device()
+
+    # ------------------------------------------------------------- bind utils
+    def _dictify(self, data, names, what, allow_none=False, partial=False):
+        if data is None:
+            if allow_none:
+                return {}
+            raise MXNetError("%s must be provided" % what)
+        if isinstance(data, dict):
+            out = {}
+            for n in names:
+                if n in data:
+                    out[n] = data[n]
+                elif not (allow_none or partial):
+                    raise MXNetError("missing %s entry %s" % (what, n))
+            return out
+        data = list(data)
+        if len(data) != len(names) and not partial:
+            raise MXNetError("%s length %d != expected %d"
+                             % (what, len(data), len(names)))
+        return {n: a for n, a in zip(names, data) if a is not None}
+
+    def _detect_multi_device(self):
+        if self._group2ctx:
+            ctxs = set(self._group2ctx.values())
+            if len(ctxs) > 1:
+                return True
+        devs = set()
+        for a in list(self.arg_dict.values()) + list(self.aux_dict.values()):
+            devs.add(a.context)
+        return len(devs) > 1
+
+    @staticmethod
+    def simple_bind(symbol, ctx, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_exec=None, **kwargs):
+        """Allocate argument/grad/aux arrays from inferred shapes and bind
+        (parity: symbol.simple_bind / MXExecutorSimpleBind)."""
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
+        if arg_shapes is None:
+            raise MXNetError("simple_bind: could not infer all shapes from %s"
+                             % kwargs)
+        arg_types = dict(type_dict or {})
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        shared_args = shared_exec.arg_dict if shared_exec else {}
+        shared_grads = shared_exec.grad_dict if shared_exec else {}
+        shared_aux = shared_exec.aux_dict if shared_exec else {}
+
+        def node_ctx(name):
+            if group2ctx:
+                # find the variable's ctx_group attribute
+                from .symbol import _topo
+                for n in _topo([x for x, _ in symbol._outputs]):
+                    if n.is_var and n.name == name:
+                        grp = n.attr.get("ctx_group")
+                        if grp and grp in group2ctx:
+                            return group2ctx[grp]
+            return ctx
+
+        args = {}
+        grads = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            dt = arg_types.get(name, _np.float32)
+            c = node_ctx(name)
+            if name in shared_args and shared_args[name].shape == shape:
+                args[name] = shared_args[name]
+            else:
+                args[name] = nd.zeros(shape, ctx=c, dtype=dt)
+            req = grad_req if isinstance(grad_req, string_types) else \
+                (grad_req[arg_names.index(name)]
+                 if isinstance(grad_req, (list, tuple))
+                 else grad_req.get(name, "null"))
+            if req != "null":
+                if name in shared_grads and shared_grads[name].shape == shape:
+                    grads[name] = shared_grads[name]
+                else:
+                    grads[name] = nd.zeros(shape, ctx=c, dtype=dt)
+        auxs = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            if name in shared_aux and shared_aux[name].shape == shape:
+                auxs[name] = shared_aux[name]
+            else:
+                auxs[name] = nd.zeros(shape, ctx=ctx)
+        return Executor(symbol, ctx, args, grads, grad_req, auxs,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    # -------------------------------------------------------------- properties
+    @property
+    def outputs(self):
+        return self._output_nds
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self.arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self.arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self.aux_names]
+
+    # ------------------------------------------------------------------ compute
+    def _grad_arg_names(self):
+        return [n for n in self.arg_names
+                if self.grad_req.get(n, "null") != "null" and n in self.grad_dict]
+
+    def _get_jit(self, kind):
+        """kind: 'fwd_test' | 'fwd_train' | 'fused' | 'bwd'."""
+        import jax
+        fn = self._jit_cache.get(kind)
+        if fn is not None:
+            return fn
+        low = self._low
+        grad_names = tuple(self._grad_arg_names())
+
+        if kind in ("fwd_test", "fwd_train"):
+            is_train = kind == "fwd_train"
+
+            def fwd(args, aux, rng):
+                outs, aux_upd = low.run(args, aux, rng, is_train)
+                return outs, aux_upd
+            fn = jax.jit(fwd)
+        else:
+            def fused(gargs, oargs, aux, rng, out_grads):
+                def f(ga):
+                    all_args = dict(oargs)
+                    all_args.update(ga)
+                    outs, aux_upd = low.run(all_args, aux, rng, True)
+                    return tuple(outs), aux_upd
+                outs, vjp_fn, aux_upd = jax.vjp(f, gargs, has_aux=True)
+                grads = vjp_fn(tuple(out_grads))[0]
+                return list(outs), aux_upd, grads
+            fn = jax.jit(fused)
+        self._jit_cache[kind] = fn
+        return fn
+
+    def _arg_values(self):
+        return {n: a.value for n, a in self.arg_dict.items()}
+
+    def _aux_values(self):
+        return {n: a.value for n, a in self.aux_dict.items()}
+
+    def forward(self, is_train=False, **kwargs):
+        """Run forward (parity: Executor::Forward).  With is_train=True the fused
+        forward+backward computation runs (one XLA program for the whole step);
+        gradients are cached for the subsequent backward() call."""
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown forward input %s" % k)
+            if isinstance(v, NDArray):
+                self.arg_dict[k]._set_value(v.value)
+            else:
+                self.arg_dict[k][:] = v
+        rng = _random.next_key()
+        self._cached_grads = None
+        if self._multi_device:
+            outs, aux_upd = self._forward_eager(is_train, rng)
+        elif is_train and self._grad_arg_names():
+            gnames = self._grad_arg_names()
+            argv = self._arg_values()
+            gargs = {n: argv[n] for n in gnames}
+            oargs = {n: v for n, v in argv.items() if n not in gargs}
+            out_grads = [_ones_like_val(o) for o in self._output_nds]
+            fn = self._get_jit("fused")
+            outs, aux_upd, grads = fn(gargs, oargs, self._aux_values(), rng,
+                                      out_grads)
+            self._cached_grads = grads
+        else:
+            fn = self._get_jit("fwd_train" if is_train else "fwd_test")
+            outs, aux_upd = fn(self._arg_values(), self._aux_values(), rng)
+        for ndarr, v in zip(self._output_nds, outs):
+            ndarr._set_value(v)
+        if is_train:
+            for name, v in aux_upd.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._set_value(v)
+        if self._monitor_cb is not None:
+            self._run_monitor(is_train, rng)
+        return self._output_nds
+
+    def backward(self, out_grads=None):
+        """Accumulate gradients into bound grad arrays (parity:
+        Executor::Backward; grad_req write/add semantics)."""
+        gnames = self._grad_arg_names()
+        if not gnames:
+            return
+        if out_grads is None and self._cached_grads is not None:
+            grads = self._cached_grads
+        else:
+            import jax
+            if out_grads is None:
+                ogs = [_ones_like_val(o) for o in self._output_nds]
+            else:
+                if isinstance(out_grads, NDArray):
+                    out_grads = [out_grads]
+                ogs = [g.value for g in out_grads]
+            argv = self._arg_values()
+            gargs = {n: argv[n] for n in gnames}
+            oargs = {n: v for n, v in argv.items() if n not in gargs}
+            fn = self._get_jit("fused")
+            _, _, grads = fn(gargs, oargs, self._aux_values(),
+                             _random.next_key(), ogs)
+        for name in gnames:
+            req = self.grad_req[name]
+            tgt = self.grad_dict[name]
+            if req == "write":
+                tgt._set_value(grads[name])
+            elif req == "add":
+                tgt._set_value(tgt.value + grads[name])
+
+    def _forward_eager(self, is_train, rng):
+        """Eager multi-device walk for group2ctx model parallelism: every op runs
+        on the device of its (committed) inputs; ctx_group changes insert
+        device transfers (parity: PlaceDevice + _CrossDeviceCopy)."""
+        import jax
+        low = self._low
+        dev_of = {}
+
+        def want_dev(node):
+            grp = node.attr.get("ctx_group")
+            if grp and grp in self._group2ctx:
+                return self._group2ctx[grp].jax_device()
+            return None
+
+        values = {}
+        aux_updates = {}
+        for node in low.order:
+            if node.is_var:
+                src = self.arg_dict.get(node.name) or self.aux_dict.get(node.name)
+                if src is None:
+                    raise MXNetError("unbound variable %s" % node.name)
+                values[(id(node), 0)] = src.value
+                continue
+            tgt = want_dev(node)
+            ins = []
+            for c, i in node.inputs:
+                v = values[(id(c), i)]
+                if tgt is not None and hasattr(v, "devices") and \
+                        tgt not in v.devices():
+                    v = jax.device_put(v, tgt)
+                ins.append(v)
+            call = node.op.make_callable(node.params, is_train)
+            if node.op.needs_rng:
+                out = call(jax.random.fold_in(rng, _node_uid(node, low.uid)),
+                           *ins)
+            else:
+                out = call(*ins)
+            if not isinstance(out, (tuple, list)):
+                out = (out,)
+            n_vis = node.op.num_outputs_for(node.params)
+            for i in range(n_vis):
+                values[(id(node), i)] = out[i]
+            if node.op.num_aux and is_train:
+                names = node.op.arg_names_for(node.params)
+                aux_pos = [i for i, nm in enumerate(names)
+                           if nm in node.op.aux_names]
+                for k, pos in enumerate(aux_pos):
+                    child = node.inputs[pos][0]
+                    if child.is_var:
+                        aux_updates[child.name] = out[n_vis + k]
+        outs = [values[k] for k in low.out_keys]
+        if is_train and self._grad_arg_names():
+            # eager vjp across devices
+            gnames = self._grad_arg_names()
+
+            def f(gargs):
+                merged = {n: a.value for n, a in self.arg_dict.items()}
+                merged.update(gargs)
+                o, _ = low.run(merged, self._aux_values(), rng, True)
+                return tuple(o)
+            primals = {n: self.arg_dict[n].value for n in gnames}
+            _, vjp_fn = jax.vjp(f, primals)
+            ogs = tuple(_ones_like_val(v) for v in outs)
+            self._cached_grads = vjp_fn(ogs)[0]
+        return outs, aux_updates
+
+    # ---------------------------------------------------------------- utility
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._set_value(
+                    nd.array(arr).astype(self.arg_dict[name].dtype).value
+                    if not isinstance(arr, NDArray) else arr.value)
+            elif not allow_extra_params:
+                raise MXNetError("unknown arg %s" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._set_value(
+                        arr.value if isinstance(arr, NDArray)
+                        else nd.array(arr).value)
+                elif not allow_extra_params:
+                    raise MXNetError("unknown aux %s" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new input shapes, sharing parameter arrays (parity:
+        executor.reshape; XLA recompiles per shape, parameters are shared)."""
+        new_shapes = {n: a.shape for n, a in self.arg_dict.items()}
+        new_shapes.update(kwargs)
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        if arg_shapes is None:
+            raise MXNetError("reshape: cannot infer shapes")
+        args = {}
+        for name, shape in zip(self.arg_names, arg_shapes):
+            cur = self.arg_dict[name]
+            args[name] = cur if tuple(cur.shape) == tuple(shape) else \
+                nd.zeros(shape, ctx=cur.context, dtype=cur.dtype)
+        grads = {}
+        for name, arr in self.grad_dict.items():
+            shape = arg_shapes[self.arg_names.index(name)]
+            grads[name] = arr if tuple(arr.shape) == tuple(shape) else \
+                nd.zeros(shape, ctx=arr.context, dtype=arr.dtype)
+        auxs = {}
+        for name, shape in zip(self.aux_names, aux_shapes):
+            cur = self.aux_dict[name]
+            auxs[name] = cur if tuple(cur.shape) == tuple(shape) else \
+                nd.zeros(shape, ctx=cur.context)
+        return Executor(self._symbol, self._ctx, args, grads, self.grad_req,
+                        auxs, group2ctx=self._group2ctx)
+
+    def set_monitor_callback(self, callback):
+        """Install per-op output monitor (parity: MXExecutorSetMonitorCallback)."""
+        self._monitor_cb = callback
+
+    def _run_monitor(self, is_train, rng):
+        low = self._low
+        internals = self._symbol.get_internals()
+        ex_low = _Lowered(internals)
+        outs, _ = ex_low.run(self._arg_values(), self._aux_values(), rng,
+                             is_train)
+        for (node, idx), val in zip(internals._outputs, outs):
+            if node.is_var:
+                continue
+            name = node.name + ("_output" if node.num_outputs() == 1
+                                else "_output%d" % idx)
+            self._monitor_cb(name, NDArray(val))
+
+    def debug_str(self):
+        return self._symbol.debug_str()
+
+
+def _ones_like_val(ndarr):
+    import jax.numpy as jnp
+    v = ndarr.value if isinstance(ndarr, NDArray) else ndarr
+    return jnp.ones(v.shape, v.dtype)

@@ -1,0 +1,545 @@
+"""Symbol — the symbolic graph IR (parity: reference python/mxnet/symbol.py and the
+nnvm submodule's Symbol/Graph; SURVEY.md §2.9).
+
+TPU-first: a Symbol is a lightweight Python DAG whose nodes reference registered
+JAX operators.  There is no separate C++ graph compiler — ``bind`` lowers the whole
+DAG into one traced JAX function (→ single XLA HLO computation), which is the NNVM
+pass pipeline's TPU-era replacement: Gradient = jax.vjp, PlanMemory/fusion = XLA,
+PlaceDevice = shardings/device_put (see executor.py).
+
+JSON save/load mirrors the nnvm format shape (nodes/arg_nodes/heads) so graphs are
+inspectable and checkpoints round-trip (parity: Symbol::SaveJSON, legacy
+src/nnvm/legacy_json_util.cc role).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as _np
+
+from .attribute import AttrScope
+from .base import MXNetError, string_types
+from .context import current_context
+from . import name as _name_mgr
+from .ops import registry as _reg
+
+__all__ = ["Symbol", "Variable", "Group", "load", "load_json", "var"]
+
+
+class _Node(object):
+    """One graph node: a variable (op is None) or an operator application."""
+
+    __slots__ = ("op", "name", "params", "attr", "inputs", "_arg_names")
+
+    def __init__(self, op, name, params=None, attr=None, inputs=None,
+                 arg_names=None):
+        self.op = op
+        self.name = name
+        self.params = dict(params or {})
+        self.attr = dict(attr or {})
+        self.inputs = list(inputs or [])  # list of (_Node, out_index)
+        self._arg_names = arg_names       # resolved input names (op nodes)
+
+    @property
+    def is_var(self):
+        return self.op is None
+
+    def num_outputs(self):
+        if self.is_var:
+            return 1
+        return self.op.num_outputs_for(self.params)
+
+
+def _topo(nodes_out):
+    """Post-order DFS over the DAG feeding the given output nodes."""
+    seen = {}
+    order = []
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen[id(node)] = node
+        for (child, _) in node.inputs:
+            visit(child)
+        order.append(node)
+
+    for n in nodes_out:
+        visit(n)
+    return order
+
+
+class Symbol(object):
+    """An (immutable) reference to one or more outputs of the graph."""
+
+    __slots__ = ("_outputs",)
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)  # list of (_Node, out_index)
+
+    # ----------------------------------------------------------- composition
+    def __call__(self, *args, **kwargs):
+        raise MXNetError("symbol re-composition is not supported; "
+                         "build a new symbol instead")
+
+    def __getitem__(self, index):
+        if isinstance(index, string_types):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError("cannot find output %s" % index)
+            index = names.index(index)
+        return Symbol([self._outputs[index]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self._outputs)))
+
+    # ------------------------------------------------------------ arithmetic
+    def __add__(self, other):
+        return _sym_binary("_plus", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return _sym_binary("_minus", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        return _sym_scalar("_rminus_scalar", self, other)
+
+    def __mul__(self, other):
+        return _sym_binary("_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __div__(self, other):
+        return _sym_binary("_div", "_div_scalar", self, other)
+
+    __truediv__ = __div__
+
+    def __rdiv__(self, other):
+        return _sym_scalar("_rdiv_scalar", self, other)
+
+    __rtruediv__ = __rdiv__
+
+    def __pow__(self, other):
+        return _sym_binary("_power", "_power_scalar", self, other)
+
+    def __neg__(self):
+        return create("negative", data=self)
+
+    # -------------------------------------------------------------- listing
+    @property
+    def name(self):
+        if len(self._outputs) > 1:
+            return None
+        node, _ = self._outputs[0]
+        return node.name
+
+    def _aux_node_ids(self):
+        """ids of variable nodes that feed auxiliary-state input slots."""
+        aux = set()
+        for node in _topo([n for n, _ in self._outputs]):
+            if node.is_var or not node.op.num_aux:
+                continue
+            names = node.op.arg_names_for(node.params)
+            for i, nm in enumerate(names):
+                if nm in node.op.aux_names and i < len(node.inputs):
+                    child = node.inputs[i][0]
+                    if child.is_var:
+                        aux.add(id(child))
+        return aux
+
+    def list_arguments(self):
+        aux = self._aux_node_ids()
+        return [n.name for n in _topo([n for n, _ in self._outputs])
+                if n.is_var and id(n) not in aux]
+
+    def list_auxiliary_states(self):
+        aux = self._aux_node_ids()
+        return [n.name for n in _topo([n for n, _ in self._outputs])
+                if n.is_var and id(n) in aux]
+
+    def list_outputs(self):
+        out = []
+        for node, idx in self._outputs:
+            if node.is_var:
+                out.append(node.name)
+            elif node.num_outputs() == 1:
+                out.append(node.name + "_output")
+            else:
+                out.append("%s_output%d" % (node.name, idx))
+        return out
+
+    def get_internals(self):
+        """Every node output as a Group (parity: symbol.get_internals)."""
+        outs = []
+        for node in _topo([n for n, _ in self._outputs]):
+            for i in range(node.num_outputs()):
+                outs.append((node, i))
+        return Symbol(outs)
+
+    def attr(self, key):
+        if len(self._outputs) != 1:
+            return None
+        node = self._outputs[0][0]
+        return node.attr.get(key)
+
+    def attr_dict(self):
+        ret = {}
+        for node in _topo([n for n, _ in self._outputs]):
+            d = dict(node.attr)
+            if not node.is_var:
+                d.update({k: _attr_str(v) for k, v in node.params.items()})
+            if d:
+                ret[node.name] = d
+        return ret
+
+    def _set_attr(self, **kwargs):
+        for node, _ in self._outputs:
+            node.attr.update(kwargs)
+
+    # ------------------------------------------------------------- inference
+    def infer_shape(self, *args, **kwargs):
+        arg_shapes, out_shapes, aux_shapes = self._infer_shape_impl(
+            *args, **kwargs)
+        if arg_shapes is not None and any(s is None for s in arg_shapes):
+            return None, None, None
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(*args, **kwargs)
+
+    def _infer_shape_impl(self, *args, **kwargs):
+        if args and kwargs:
+            raise MXNetError("cannot mix positional and keyword shape args")
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    known[n] = tuple(s)
+        for k, v in kwargs.items():
+            known[k] = tuple(v)
+        shapes = _run_shape_inference(self, known)
+        node_shapes, _ = shapes
+        arg_shapes = [node_shapes.get(n) for n in arg_names]
+        aux_shapes = [node_shapes.get(n) for n in self.list_auxiliary_states()]
+        out_shapes = [shapes[1].get((id(node), idx))
+                      for node, idx in self._outputs]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for n, t in zip(arg_names, args):
+                if t is not None:
+                    known[n] = _np.dtype(t)
+        for k, v in kwargs.items():
+            known[k] = _np.dtype(v)
+        # forward-propagate: default float32 on unknown args
+        var_types = {}
+        out_types = {}
+        for node in _topo([n for n, _ in self._outputs]):
+            if node.is_var:
+                var_types[node.name] = known.get(node.name, _np.float32)
+        for node in _topo([n for n, _ in self._outputs]):
+            if node.is_var:
+                out_types[(id(node), 0)] = var_types[node.name]
+            else:
+                in_t = [out_types.get((id(c), i)) for c, i in node.inputs]
+                _, outs, _ = node.op.infer_type(node.params, in_t)
+                for i, t in enumerate(outs):
+                    out_types[(id(node), i)] = t
+        args_t = [var_types.get(n) for n in arg_names]
+        auxs_t = [var_types.get(n) for n in self.list_auxiliary_states()]
+        outs_t = [out_types.get((id(n), i)) for n, i in self._outputs]
+        return args_t, outs_t, auxs_t
+
+    # ----------------------------------------------------------------- serde
+    def tojson(self):
+        nodes = _topo([n for n, _ in self._outputs])
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": "null" if n.is_var else n.op.name,
+                "name": n.name,
+                "param": {} if n.is_var else
+                         {k: _attr_str(v) for k, v in n.params.items()},
+                "attr": dict(n.attr),
+                "inputs": [[nid[id(c)], i, 0] for c, i in n.inputs],
+            })
+        return json.dumps({
+            "nodes": jnodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.is_var],
+            "heads": [[nid[id(n)], i, 0] for n, i in self._outputs],
+            "attrs": {"mxnet_tpu_version": 1},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def debug_str(self):
+        lines = []
+        for n in _topo([n for n, _ in self._outputs]):
+            kind = "Variable" if n.is_var else n.op.name
+            lines.append("%s %s(%s)" % (
+                kind, n.name, ", ".join(c.name for c, _ in n.inputs)))
+        return "\n".join(lines)
+
+    def __repr__(self):
+        name = self.name
+        return "<Symbol %s>" % (name if name else "Grouped")
+
+    # --------------------------------------------------------------- binding
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    group2ctx=None, shared_exec=None, **kwargs):
+        from .executor import Executor
+        return Executor.simple_bind(self, ctx or current_context(),
+                                    grad_req=grad_req, type_dict=type_dict,
+                                    group2ctx=group2ctx,
+                                    shared_exec=shared_exec, **kwargs)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from .executor import Executor
+        return Executor(self, ctx or current_context(), args, args_grad,
+                        grad_req, aux_states, group2ctx=group2ctx,
+                        shared_exec=shared_exec)
+
+    def grad(self, wrt):
+        raise MXNetError("symbol.grad is deprecated; use bind + backward")
+
+    # ------------------------------------------------------------- evaluation
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx or current_context(), args=kwargs)
+        return ex.forward()
+
+
+def _attr_str(v):
+    if isinstance(v, (tuple, list)):
+        return "(" + ", ".join(str(x) for x in v) + ")"
+    if v is None:
+        return "None"
+    if isinstance(v, _np.dtype):
+        return v.name
+    if isinstance(v, type):
+        return getattr(v, "__name__", str(v))
+    return str(v)
+
+
+# -------------------------------------------------------------- construction
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None):
+    """Create a variable symbol (parity: mx.sym.Variable)."""
+    if not isinstance(name, string_types):
+        raise TypeError("Expect a string for variable name")
+    attr = AttrScope.current().get(attr)
+    attr = dict(attr or {})
+    if shape is not None:
+        attr["__shape__"] = str(tuple(shape))
+    if lr_mult is not None:
+        attr["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        attr["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        attr["__dtype__"] = str(_np.dtype(dtype))
+    if init is not None:
+        attr["__init__"] = init if isinstance(init, string_types) else \
+            init.dumps()
+    return Symbol([(_Node(None, name, attr=attr), 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    """Group symbols into one multi-output symbol (parity: mx.sym.Group)."""
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def create(op_name, *args, **kwargs):
+    """Create a node applying ``op_name`` (the generic symbol constructor)."""
+    op = _reg.get_op(op_name)
+    name = kwargs.pop("name", None)
+    attr = kwargs.pop("attr", None)
+    attr = AttrScope.current().get(attr)
+    # split symbol inputs from op params
+    sym_kwargs = {}
+    params = {}
+    for k, v in kwargs.items():
+        if isinstance(v, Symbol):
+            sym_kwargs[k] = v
+        elif isinstance(v, (list, tuple)) and v and all(
+                isinstance(x, Symbol) for x in v):
+            sym_kwargs[k] = v
+        else:
+            params[k] = v
+    pos_syms = []
+    for a in args:
+        if isinstance(a, Symbol):
+            pos_syms.append(a)
+        elif isinstance(a, (list, tuple)) and all(isinstance(x, Symbol) for x in a):
+            pos_syms.extend(a)
+        else:
+            raise MXNetError("positional arguments to %s must be Symbols"
+                             % op_name)
+    if op.key_var_num_args and op.key_var_num_args not in params:
+        n = len(pos_syms) + len(sym_kwargs)
+        params[op.key_var_num_args] = n
+    params = op.normalize_attrs(params)
+    hint = op.name.lower().lstrip("_")
+    name = _name_mgr.current().get(name, hint)
+    arg_names = op.arg_names_for(params)
+    # resolve inputs by name; auto-create missing variables as {name}_{arg}
+    inputs = []
+    pos_iter = iter(pos_syms)
+    for an in arg_names:
+        if an in sym_kwargs:
+            s = sym_kwargs.pop(an)
+        else:
+            s = next(pos_iter, None)
+        if s is None:
+            s = Variable("%s_%s" % (name, an))
+        if len(s._outputs) != 1:
+            raise MXNetError("cannot feed grouped symbol to input %s" % an)
+        inputs.append(s._outputs[0])
+    leftover = list(pos_iter)
+    if leftover or sym_kwargs:
+        raise MXNetError("unexpected inputs to %s: %d positional, kw=%s"
+                         % (op_name, len(leftover), list(sym_kwargs)))
+    node = _Node(op, name, params=params, attr=attr, inputs=inputs,
+                 arg_names=arg_names)
+    n_out = node.num_outputs()
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def _as_symbol(other):
+    if isinstance(other, Symbol):
+        return other
+    raise MXNetError("cannot convert %s to Symbol" % type(other))
+
+
+def _sym_binary(op, scalar_op, lhs, rhs):
+    if isinstance(rhs, Symbol):
+        return create(op, lhs=lhs, rhs=rhs)
+    return _sym_scalar(scalar_op, lhs, rhs)
+
+
+def _sym_scalar(scalar_op, data, scalar):
+    return create(scalar_op, data=data, scalar=float(scalar))
+
+
+# -------------------------------------------------------------------- loading
+def load_json(json_str):
+    """Load a symbol from its JSON string (parity: mx.sym.load_json)."""
+    data = json.loads(json_str)
+    nodes = []
+    for jn in data["nodes"]:
+        if jn["op"] == "null":
+            node = _Node(None, jn["name"], attr=jn.get("attr", {}))
+        else:
+            op = _reg.get_op(jn["op"])
+            params = op.normalize_attrs(jn.get("param", {}))
+            node = _Node(op, jn["name"], params=params,
+                         attr=jn.get("attr", {}))
+            node.inputs = [(nodes[i], oi) for i, oi, _ in jn["inputs"]]
+            node._arg_names = op.arg_names_for(params)
+        nodes.append(node)
+    return Symbol([(nodes[i], oi) for i, oi, _ in data["heads"]])
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# ------------------------------------------------------------ shape inference
+def _run_shape_inference(symbol, known):
+    """Fixpoint bidirectional shape propagation over the DAG.
+
+    Returns (var_shapes: name->shape, out_shapes: (node_id, idx)->shape).
+    Parity: nnvm InferShape pass + per-op bidirectional rules.
+    """
+    out_nodes = [n for n, _ in symbol._outputs]
+    order = _topo(out_nodes)
+    var_shapes = dict(known)
+    # shapes declared on the Variable itself
+    for n in order:
+        if n.is_var and "__shape__" in n.attr and n.name not in var_shapes:
+            from .ops.registry import parse_tuple
+            var_shapes[n.name] = parse_tuple(n.attr["__shape__"])
+    out_shapes = {}
+    for _ in range(3):
+        changed = False
+        for node in order:
+            if node.is_var:
+                s = var_shapes.get(node.name)
+                if out_shapes.get((id(node), 0)) != s and s is not None:
+                    out_shapes[(id(node), 0)] = tuple(s)
+                    changed = True
+                continue
+            in_shapes = [out_shapes.get((id(c), i)) for c, i in node.inputs]
+            try:
+                new_in, new_out, _aux = node.op.infer_shape(node.params,
+                                                            in_shapes)
+            except Exception:
+                continue
+            # write back newly deduced input shapes to variable children
+            for (child, ci), s in zip(node.inputs, new_in):
+                if s is None:
+                    continue
+                s = tuple(int(x) for x in s)
+                if child.is_var and var_shapes.get(child.name) is None:
+                    var_shapes[child.name] = s
+                    changed = True
+                if out_shapes.get((id(child), ci)) is None:
+                    out_shapes[(id(child), ci)] = s
+                    changed = True
+            for i, s in enumerate(new_out or []):
+                if s is not None:
+                    s = tuple(int(x) for x in s)
+                    if out_shapes.get((id(node), i)) != s:
+                        out_shapes[(id(node), i)] = s
+                        changed = True
+        if not changed:
+            break
+    return var_shapes, out_shapes
+
+
+# ------------------------------------------------- autogenerated constructors
+def _make_symbol_function(op):
+    def fn(*args, **kwargs):
+        return create(op.name, *args, **kwargs)
+
+    fn.__name__ = op.name
+    fn.__doc__ = op.doc
+    return fn
+
+
+def _init_symbol_module(target):
+    seen = {}
+    for nm in _reg.list_ops():
+        if nm in target:
+            continue
+        op = _reg.get_op(nm)
+        fn = seen.get(id(op))
+        if fn is None:
+            fn = _make_symbol_function(op)
+            seen[id(op)] = fn
+        target[nm] = fn
+
+
+_init_symbol_module(globals())
+
+# convenience: mx.sym.zeros/ones as symbols of init ops
+zeros = globals()["_zeros"]
+ones = globals()["_ones"]

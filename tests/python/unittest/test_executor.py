@@ -1,0 +1,162 @@
+"""Executor tests (parity model: reference tests/python/unittest/test_executor.py).
+Checks forward/backward numerics vs numpy, grad_req write/add/null, aux updates,
+reshape, simple_bind."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_bind_forward_backward_mul():
+    x = np.random.uniform(-1, 1, (4, 5)).astype(np.float32)
+    y = np.random.uniform(-1, 1, (4, 5)).astype(np.float32)
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a * b
+    a_nd, b_nd = mx.nd.array(x), mx.nd.array(y)
+    ga, gb = mx.nd.zeros((4, 5)), mx.nd.zeros((4, 5))
+    ex = c.bind(mx.cpu(), args={"a": a_nd, "b": b_nd},
+                args_grad={"a": ga, "b": gb})
+    out = ex.forward(is_train=True)
+    np.testing.assert_allclose(out[0].asnumpy(), x * y, rtol=1e-5)
+    og = np.random.uniform(-1, 1, (4, 5)).astype(np.float32)
+    ex.backward(mx.nd.array(og))
+    np.testing.assert_allclose(ga.asnumpy(), og * y, rtol=1e-5)
+    np.testing.assert_allclose(gb.asnumpy(), og * x, rtol=1e-5)
+
+
+def test_grad_req_add():
+    x = np.random.uniform(-1, 1, (3, 3)).astype(np.float32)
+    a = mx.sym.Variable("a")
+    c = 2 * a
+    ga = mx.nd.ones((3, 3))
+    ex = c.bind(mx.cpu(), args={"a": mx.nd.array(x)}, args_grad={"a": ga},
+                grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward(mx.nd.ones((3, 3)))
+    np.testing.assert_allclose(ga.asnumpy(), 1 + 2 * np.ones((3, 3)),
+                               rtol=1e-5)
+    ex.backward(mx.nd.ones((3, 3)))
+    np.testing.assert_allclose(ga.asnumpy(), 1 + 4 * np.ones((3, 3)),
+                               rtol=1e-5)
+
+
+def test_grad_req_null():
+    a = mx.sym.Variable("a")
+    c = a * 3
+    ex = c.bind(mx.cpu(), args={"a": mx.nd.ones((2, 2))}, grad_req="null")
+    ex.forward(is_train=True)
+    ex.backward()  # should be a no-op, not crash
+
+
+def test_simple_bind_mlp_softmax_grad():
+    """End-to-end check of SoftmaxOutput custom gradient: dL/dlogits = p - y."""
+    batch, nclass = 6, 4
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=nclass, name="fc")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax", normalization="null")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(batch, 8))
+    x = np.random.randn(batch, 8).astype(np.float32)
+    w = np.random.randn(nclass, 8).astype(np.float32) * 0.1
+    label = np.random.randint(0, nclass, (batch,)).astype(np.float32)
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["fc_weight"][:] = w
+    ex.arg_dict["fc_bias"][:] = 0
+    ex.arg_dict["softmax_label"][:] = label
+    out = ex.forward(is_train=True)[0].asnumpy()
+    logits = x.dot(w.T)
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    np.testing.assert_allclose(out, p, rtol=5e-3, atol=5e-4)
+    ex.backward()
+    onehot = np.eye(nclass)[label.astype(int)]
+    expected_gdata = (p - onehot).dot(w)
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), expected_gdata,
+                               rtol=2e-2, atol=2e-3)
+    expected_gw = (p - onehot).T.dot(x)
+    np.testing.assert_allclose(ex.grad_dict["fc_weight"].asnumpy(),
+                               expected_gw, rtol=2e-2, atol=2e-3)
+
+
+def test_batchnorm_aux_update():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn", momentum=0.5)
+    ex = bn.simple_bind(ctx=mx.cpu(), data=(8, 3, 4, 4))
+    x = np.random.randn(8, 3, 4, 4).astype(np.float32) * 2 + 1
+    ex.arg_dict["data"][:] = x
+    ex.forward(is_train=True)
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    batch_mean = x.mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(mm, 0.5 * batch_mean, rtol=1e-4, atol=1e-5)
+    # eval mode uses moving stats and does not update them
+    ex.forward(is_train=False)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(), mm)
+
+
+def test_outputs_updated_in_place():
+    a = mx.sym.Variable("a")
+    s = a * 2
+    a_nd = mx.nd.ones((2,))
+    ex = s.bind(mx.cpu(), args={"a": a_nd})
+    out = ex.outputs[0]
+    ex.forward()
+    np.testing.assert_allclose(out.asnumpy(), [2, 2])
+    a_nd[:] = 5
+    ex.forward()
+    np.testing.assert_allclose(out.asnumpy(), [10, 10])
+
+
+def test_reshape():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    ex = fc.simple_bind(ctx=mx.cpu(), data=(2, 6))
+    ex.arg_dict["fc_weight"][:] = 1.0
+    ex2 = ex.reshape(data=(5, 6))
+    assert ex2.arg_dict["data"].shape == (5, 6)
+    # parameters shared with original executor
+    assert ex2.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+    ex2.arg_dict["data"][:] = 1.0
+    out = ex2.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, np.full((5, 4), 6.0), rtol=1e-5)
+
+
+def test_dropout_modes():
+    data = mx.sym.Variable("data")
+    dp = mx.sym.Dropout(data, p=0.5, name="dp")
+    ex = dp.simple_bind(ctx=mx.cpu(), data=(100, 100), grad_req="null")
+    ex.arg_dict["data"][:] = 1.0
+    out_eval = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out_eval, np.ones((100, 100)))
+    out_train = ex.forward(is_train=True)[0].asnumpy()
+    frac = (out_train == 0).mean()
+    assert 0.4 < frac < 0.6
+    # kept entries are scaled by 1/keep
+    assert np.allclose(out_train[out_train != 0], 2.0)
+
+
+def test_linear_regression_grad():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    out = mx.sym.LinearRegressionOutput(data=data, label=label, name="lro")
+    x = np.random.randn(5, 3).astype(np.float32)
+    y = np.random.randn(5, 3).astype(np.float32)
+    gd = mx.nd.zeros((5, 3))
+    ex = out.bind(mx.cpu(), args={"data": mx.nd.array(x),
+                                  "label": mx.nd.array(y)},
+                  args_grad={"data": gd},
+                  grad_req={"data": "write", "label": "null"})
+    o = ex.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(o, x, rtol=1e-6)
+    ex.backward()
+    np.testing.assert_allclose(gd.asnumpy(), (x - y) / 3.0, rtol=1e-4)
+
+
+def test_monitor_callback():
+    seen = {}
+    data = mx.sym.Variable("data")
+    s = mx.sym.relu(data, name="r1")
+    ex = s.bind(mx.cpu(), args={"data": mx.nd.array(
+        np.array([-1.0, 2.0], dtype=np.float32))})
+    ex.set_monitor_callback(lambda name, arr: seen.update({name: arr.asnumpy()}))
+    ex.forward()
+    assert "r1_output" in seen
+    np.testing.assert_allclose(seen["r1_output"], [0.0, 2.0])

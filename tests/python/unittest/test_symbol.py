@@ -1,0 +1,150 @@
+"""Symbol tests (parity model: reference tests/python/unittest/test_symbol.py,
+test_infer_shape.py, test_attr.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def mlp_sym():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=128)
+    act1 = mx.sym.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(data=act1, name="fc2", num_hidden=10)
+    return mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def test_compose_and_list():
+    net = mlp_sym()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+    assert net.name == "softmax"
+
+
+def test_infer_shape():
+    net = mlp_sym()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 784))
+    args = dict(zip(net.list_arguments(), arg_shapes))
+    assert args["fc1_weight"] == (128, 784)
+    assert args["fc1_bias"] == (128,)
+    assert args["fc2_weight"] == (10, 128)
+    assert args["softmax_label"] == (32,)
+    assert out_shapes == [(32, 10)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_partial():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=16, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    assert out_shapes == [None]
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial(data=(4, 8))
+    assert out_shapes == [(4, 16)]
+    # full inference fails cleanly when incomplete
+    r = fc.infer_shape()
+    assert r == (None, None, None)
+
+
+def test_conv_infer_shape():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=8,
+                              pad=(1, 1), name="conv")
+    pool = mx.sym.Pooling(data=conv, kernel=(2, 2), stride=(2, 2),
+                          pool_type="max", name="pool")
+    arg_shapes, out_shapes, _ = pool.infer_shape(data=(2, 3, 28, 28))
+    args = dict(zip(pool.list_arguments(), arg_shapes))
+    assert args["conv_weight"] == (8, 3, 3, 3)
+    assert args["conv_bias"] == (8,)
+    assert out_shapes == [(2, 8, 14, 14)]
+
+
+def test_batchnorm_aux():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data=data, name="bn")
+    assert bn.list_arguments() == ["data", "bn_gamma", "bn_beta"]
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(4, 3, 8, 8))
+    assert aux_shapes == [(3,), (3,)]
+    assert out_shapes == [(4, 3, 8, 8)]
+
+
+def test_symbol_arithmetic():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a + b * 2 - 1
+    args = sorted(c.list_arguments())
+    assert args == ["a", "b"]
+    ex = c.bind(mx.cpu(), args={"a": mx.nd.array([1.0, 2.0]),
+                                "b": mx.nd.array([3.0, 4.0])})
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), [6.0, 9.0])
+
+
+def test_group_and_getitem():
+    a = mx.sym.Variable("a")
+    s1 = mx.sym.relu(a, name="r")
+    s2 = mx.sym.exp(a, name="e")
+    g = mx.sym.Group([s1, s2])
+    assert g.list_outputs() == ["r_output", "e_output"]
+    assert g[1].list_outputs() == ["e_output"]
+    assert g["r_output"].list_outputs() == ["r_output"]
+    assert len(g) == 2
+
+
+def test_get_internals():
+    net = mlp_sym()
+    internals = net.get_internals()
+    outs = internals.list_outputs()
+    assert "fc1_output" in outs
+    assert "relu1_output" in outs
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_json_roundtrip(tmp_path):
+    net = mlp_sym()
+    js = net.tojson()
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    _, out_shapes, _ = net2.infer_shape(data=(8, 784))
+    assert out_shapes == [(8, 10)]
+    fname = str(tmp_path / "sym.json")
+    net.save(fname)
+    net3 = mx.sym.load(fname)
+    assert net3.tojson() == net.tojson()
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        a = mx.sym.Variable("a")
+        b = mx.sym.relu(a, name="r")
+    assert a.attr("ctx_group") == "dev1"
+    assert b.attr("ctx_group") == "dev1"
+    c = mx.sym.Variable("c")
+    assert c.attr("ctx_group") is None
+
+
+def test_variable_shape_attr():
+    a = mx.sym.Variable("a", shape=(3, 4))
+    b = mx.sym.relu(a)
+    _, out_shapes, _ = b.infer_shape()
+    assert out_shapes == [(3, 4)]
+
+
+def test_auto_naming():
+    with mx.name.NameManager():
+        a = mx.sym.Variable("a")
+        s1 = mx.sym.relu(a)
+        s2 = mx.sym.relu(a)
+        assert s1.name == "relu0"
+        assert s2.name == "relu1"
+
+
+def test_infer_type():
+    a = mx.sym.Variable("a")
+    s = mx.sym.cast(a, dtype="float16")
+    args_t, outs_t, _ = s.infer_type(a=np.float32)
+    assert args_t == [np.dtype(np.float32)]
+    assert outs_t[0] == np.dtype(np.float16)
