@@ -18,5 +18,26 @@ from . import executor
 from .executor import Executor
 from .attribute import AttrScope
 from . import name
+from . import io
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import optimizer as opt
+from . import metric
+from . import lr_scheduler
+from . import callback
+from . import kvstore
+from . import model
+from . import module
+from . import parallel
+from .module import Module
+from . import monitor
+from .monitor import Monitor
+from . import visualization
+from . import visualization as viz
+from . import recordio
+from . import profiler
+from . import rnn
+from . import test_utils
 
 __version__ = "0.1.0"

@@ -1,0 +1,191 @@
+"""KVStore — the distributed key-value parameter store (parity: reference
+python/mxnet/kvstore.py, src/kvstore/* — SURVEY.md §2.6).
+
+TPU-native design:
+- ``local`` / ``device``: single-process multi-device aggregation.  Reduce is an
+  in-process sum of per-device gradient copies (XLA handles the adds); with
+  `device` the merge stays on accelerator memory (parity: CommCPU vs CommDevice —
+  on TPU both lower to the same XLA adds, the distinction is kept for API parity).
+- ``dist_tpu`` (also accepted: ``dist_sync``, ``dist_sync_device``, ``dist``,
+  ``dist_async``): multi-host data parallelism.  Instead of a parameter-server
+  push/pull over ZMQ, push/pull bracket an XLA ``psum`` over the global device
+  mesh (see mxnet_tpu.parallel.dist): push contributes the local gradient to the
+  allreduce, pull returns the reduced result.  The async PS mode has no ICI
+  analogue and maps to the same synchronous allreduce (documented drop,
+  SURVEY.md §2.6).
+- ``set_optimizer`` installs the optimizer as the store-side updater
+  (update_on_kvstore), mirroring the reference's server-side optimizer — here it
+  becomes part of the local update step instead of a pickled command to a server.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError, string_types
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_list(key):
+    if isinstance(key, (int, string_types)):
+        return [key], True
+    return list(key), False
+
+
+def _value_list(vals, n_keys, single):
+    """Group values per key: each key maps to one NDArray or a per-device list."""
+    if single:
+        return [vals if isinstance(vals, list) else [vals]] \
+            if not (isinstance(vals, list) and vals
+                    and isinstance(vals[0], list)) else vals
+    out = []
+    for v in vals:
+        out.append(v if isinstance(v, list) else [v])
+    return out
+
+
+class KVStore(object):
+    """Key-value store for parameter synchronization."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+        self._rank = 0
+        self._num_workers = 1
+        if kv_type.startswith("dist"):
+            from .parallel import dist as _dist
+            self._rank = _dist.rank()
+            self._num_workers = _dist.num_workers()
+
+    # ------------------------------------------------------------------- api
+    def init(self, key, value):
+        """Initialize key(s) (parity: kvstore.init; rank-0 value wins)."""
+        keys, single = _key_list(key)
+        values = _value_list(value, len(keys), single)
+        for k, vlist in zip(keys, values):
+            v = vlist[0] if isinstance(vlist, list) else vlist
+            if k in self._store:
+                raise MXNetError("key %s already initialized" % str(k))
+            self._store[k] = v.copy()
+
+    def push(self, key, value, priority=0):
+        """Push gradients; aggregated across devices (and workers for dist)
+        (parity: kvstore.push → KVStoreLocal::Push / KVStoreDist::Push)."""
+        keys, single = _key_list(key)
+        values = _value_list(value, len(keys), single)
+        for k, vlist in zip(keys, values):
+            if not isinstance(vlist, list):
+                vlist = [vlist]
+            merged = _reduce(vlist)
+            if self.type.startswith("dist"):
+                from .parallel import dist as _dist
+                merged = _dist.allreduce(merged)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError("key %s not initialized" % str(k))
+                self._updater(k, merged, self._store[k])
+            else:
+                if k in self._store:
+                    self._store[k] += merged
+                else:
+                    self._store[k] = merged.copy()
+
+    def pull(self, key, out=None, priority=0):
+        """Pull current values into out array(s) (parity: kvstore.pull)."""
+        assert out is not None
+        keys, single = _key_list(key)
+        outs = _value_list(out, len(keys), single)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % str(k))
+            src = self._store[k]
+            if not isinstance(olist, list):
+                olist = [olist]
+            for o in olist:
+                o._set_value(src.value if o.context == src.context
+                             else src.copyto(o.context).value)
+
+    # -------------------------------------------------------------- optimizer
+    def set_optimizer(self, optimizer):
+        """Install optimizer as store-side updater (parity: set_optimizer;
+        replaces the pickled-command-to-server path with a local fused update)."""
+        if self.type.startswith("dist"):
+            # rescale handled by caller exactly as reference does
+            optim_str = pickle.dumps(optimizer)
+            self._send_command_to_servers(0, optim_str)
+        else:
+            self._set_updater(opt.get_updater(optimizer))
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_updater(self, updater):
+        self._set_updater(updater)
+
+    def _send_command_to_servers(self, head, body):
+        """In-process analogue of the ps-lite command channel: the 'server' is
+        this process, so install the optimizer directly."""
+        if head == 0:
+            self._set_updater(opt.get_updater(pickle.loads(body)))
+
+    # ------------------------------------------------------------- membership
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def barrier(self):
+        """Global barrier (parity: kvstore.barrier → ps Postoffice barrier)."""
+        if self.type.startswith("dist"):
+            from .parallel import dist as _dist
+            _dist.barrier()
+        nd.waitall()
+
+    def set_barrier_before_exit(self, barrier_before_exit=True):
+        self._barrier_before_exit = barrier_before_exit
+
+    def save_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot save states for distributed training")
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot load states for distributed training")
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+
+def _reduce(vlist):
+    """Sum a list of per-device NDArrays (parity: Comm*::Reduce)."""
+    if len(vlist) == 1:
+        return vlist[0]
+    target_ctx = vlist[0].context
+    acc = vlist[0]
+    out = None
+    for v in vlist[1:]:
+        v = v if v.context == target_ctx else v.copyto(target_ctx)
+        out = acc + v if out is None else out + v
+    return out if out is not None else acc
+
+
+def create(name="local"):
+    """Create a KVStore (parity: kvstore.create; types local /
+    local_allreduce_cpu / local_allreduce_device / device / dist_sync /
+    dist_async / dist_sync_device / dist_async_device / dist_tpu)."""
+    if not isinstance(name, string_types):
+        raise TypeError("name must be a string")
+    known = ("local", "device", "local_allreduce_cpu",
+             "local_allreduce_device", "dist_sync", "dist_async",
+             "dist_sync_device", "dist_async_device", "dist", "dist_tpu")
+    if name not in known:
+        raise MXNetError("unknown kvstore type %s" % name)
+    return KVStore(name)

@@ -1,0 +1,203 @@
+"""Model-level helpers shared by Module and FeedForward (parity: reference
+python/mxnet/model.py — kvstore decision logic, parameter update loops,
+checkpoint format)."""
+from __future__ import annotations
+
+import logging
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError, string_types
+from . import io
+from . import kvstore as kvs
+from . import ndarray as nd
+from . import optimizer as opt
+from . import symbol as sym_mod
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
+           "FeedForward"]
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Decide kvstore + update_on_kvstore (parity: model.py:40)."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, string_types):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                # big arrays update locally for perf (parity: model.py:58-62)
+                max_size = max(np.prod(param.shape)
+                               for param in arg_params.values())
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, string or None")
+    if kv is None:
+        update_on_kvstore = False
+    return (kv, update_on_kvstore)
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
+                        update_on_kvstore):
+    """(parity: model.py:79)"""
+    for idx, param_on_devs in enumerate(param_arrays):
+        kvstore.init(idx, arg_params[param_names[idx]])
+        if update_on_kvstore:
+            kvstore.pull(idx, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
+    """push grads, pull updated weights (parity: model.py:88)"""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        kvstore.push(index, grad_list, priority=-index)
+        kvstore.pull(index, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device,
+                   kvstore=None):
+    """aggregate via kvstore (or locally), update with local updater
+    (parity: model.py:99)"""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        if kvstore:
+            kvstore.push(index, grad_list, priority=-index)
+            kvstore.pull(index, grad_list, priority=-index)
+        else:
+            # aggregate across devices in-process
+            if num_device > 1:
+                merged = grad_list[0].copyto(grad_list[0].context)
+                for g in grad_list[1:]:
+                    merged += g.copyto(merged.context)
+                for g in grad_list:
+                    g._set_value(merged.value if g.context == merged.context
+                                 else merged.copyto(g.context).value)
+        for k, p in enumerate(zip(arg_list, grad_list)):
+            w, g = p
+            updater(index * num_device + k, g, w)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Save `prefix-symbol.json` + `prefix-%04d.params` (parity:
+    model.save_checkpoint; format per SURVEY.md §5.4)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    """(parity: model.load_checkpoint)"""
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        if tp == "aux":
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)
+
+
+class FeedForward(object):
+    """Legacy training API (parity: model.FeedForward).  Thin adapter over
+    Module — the reference docs already call it deprecated in favour of Module."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from . import initializer as init_mod
+        from .context import cpu
+        self.symbol = symbol
+        self.ctx = ctx if isinstance(ctx, list) else [ctx or cpu()]
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer or init_mod.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = kwargs.copy()
+        self._module = None
+
+    def _get_module(self, data_iter):
+        from .module import Module
+        labels = [d.name for d in (data_iter.provide_label or [])]
+        mod = Module(self.symbol, context=self.ctx,
+                     data_names=[d.name for d in data_iter.provide_data],
+                     label_names=labels or None)
+        return mod
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        train_data = self._prepare_data(X, y)
+        self._module = self._get_module(train_data)
+        self._module.fit(train_data, eval_data=eval_data,
+                         eval_metric=eval_metric,
+                         epoch_end_callback=epoch_end_callback,
+                         batch_end_callback=batch_end_callback,
+                         kvstore=kvstore,
+                         optimizer=self.optimizer,
+                         optimizer_params=self.kwargs or
+                         {"learning_rate": 0.01},
+                         initializer=self.initializer,
+                         arg_params=self.arg_params,
+                         aux_params=self.aux_params,
+                         begin_epoch=self.begin_epoch,
+                         num_epoch=self.num_epoch)
+        self.arg_params, self.aux_params = self._module.get_params()
+
+    def _prepare_data(self, X, y=None):
+        if isinstance(X, io.DataIter):
+            return X
+        return io.NDArrayIter(X, y, batch_size=self.numpy_batch_size,
+                              shuffle=False)
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        data = self._prepare_data(X)
+        if self._module is None:
+            raise MXNetError("model has not been trained")
+        outs = self._module.predict(data, num_batch)
+        return outs.asnumpy() if not isinstance(outs, list) else \
+            [o.asnumpy() for o in outs]
+
+    def score(self, X, eval_metric="acc", num_batch=None):
+        data = self._prepare_data(X)
+        res = self._module.score(data, eval_metric, num_batch)
+        return res[0][1]
+
+    def save(self, prefix, epoch=None):
+        if epoch is None:
+            epoch = self.num_epoch
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params,
+                        self.aux_params)
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)

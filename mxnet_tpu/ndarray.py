@@ -66,10 +66,17 @@ class NDArray(object):
         return v
 
     def _set_value(self, arr):
-        """Rebind contents (writes through views to the root buffer)."""
+        """Rebind contents (writes through views to the root buffer).  The
+        array stays pinned to its device: cross-device assignment transfers
+        (parity: CopyFromTo's device discipline)."""
         if not self.writable:
             raise MXNetError("trying to write to a read-only NDArray")
         if self._base is None:
+            old = self._data
+            if old is not None and hasattr(old, "devices") and \
+                    hasattr(arr, "devices") and old.devices() != arr.devices():
+                import jax
+                arr = jax.device_put(arr, next(iter(old.devices())))
             self._data = arr
         else:
             root = self._base
@@ -300,10 +307,18 @@ class NDArray(object):
         return _invoke1("broadcast_to", [self], {"shape": tuple(shape)},
                         self.context)
 
+    def __reduce__(self):
+        # pickling densifies views; used by optimizer-state serialization
+        return (_rebuild_ndarray, (self.asnumpy(), self.dtype))
+
     # engine var handle parity: the jax.Array itself is the synchronization token
     @property
     def handle(self):
         return self.value
+
+
+def _rebuild_ndarray(npv, dtype):
+    return array(npv, dtype=dtype)
 
 
 # -------------------------------------------------------------- view plumbing
@@ -440,11 +455,15 @@ def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=np.float32):
 
 
 def concatenate(arrays, axis=0, always_copy=True):
+    import jax
     jnp = _jnp()
     if len(arrays) == 1 and not always_copy:
         return arrays[0]
-    return _wrap(jnp.concatenate([a.value for a in arrays], axis=axis),
-                 arrays[0].context)
+    ctx = arrays[0].context
+    dev = ctx.jax_device()
+    vals = [a.value if dev in getattr(a.value, "devices", lambda: {dev})()
+            else jax.device_put(a.value, dev) for a in arrays]
+    return _wrap(jnp.concatenate(vals, axis=axis), ctx)
 
 
 def onehot_encode(indices, out):

@@ -17,3 +17,4 @@ from . import nn             # noqa: F401  (conv/pool/bn/act/dropout/...)
 from . import loss           # noqa: F401  (softmax_output/regression/make_loss/svm)
 from . import optimizer_ops  # noqa: F401  (optimizer_op.cc)
 from . import sequence       # noqa: F401  (sequence_*.cc)
+from . import rnn_op         # noqa: F401  (rnn.cc / cudnn_rnn-inl.h)

@@ -1,0 +1,197 @@
+"""Fused multi-layer RNN operator (parity: reference src/operator/rnn.cc
+`MXNET_REGISTER_OP_PROPERTY(RNN, RNNProp)` / cudnn_rnn-inl.h CuDNNRNNOp).
+
+TPU-native design: the whole sequence runs as ONE ``lax.scan`` over time inside
+the surrounding XLA computation — the scan body's matmuls hit the MXU, XLA
+pipelines the time steps, and autodiff-through-scan provides the backward pass
+(replacing cuDNN's fused RNN backward).  The input matmul (x·W_i2hᵀ for all
+timesteps) is hoisted out of the scan as one big batched matmul, the classic
+TPU RNN optimization.
+
+Weight layout (flat `parameters` vector), per layer then per direction:
+  W_i2h (G*H, I_layer), W_h2h (G*H, H), b_i2h (G*H,), b_h2h (G*H,)
+with G = 1 (rnn_relu/rnn_tanh), 4 (lstm, gate order i,f,g,o), 3 (gru, order
+r,z,n).  ``rnn_param_size``/``rnn_unpack_params`` expose this layout for
+FusedRNNCell.unpack_weights parity.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import register, parse_bool, parse_float, parse_int, parse_str
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _layer_param_shapes(mode, input_size, state_size, num_layers,
+                        bidirectional):
+    """Yield (layer, direction, name, shape) for the flat layout."""
+    gates = _GATES[mode]
+    ndir = 2 if bidirectional else 1
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else state_size * ndir
+        for d in range(ndir):
+            yield (layer, d, "i2h_weight", (gates * state_size, in_size))
+            yield (layer, d, "h2h_weight", (gates * state_size, state_size))
+            yield (layer, d, "i2h_bias", (gates * state_size,))
+            yield (layer, d, "h2h_bias", (gates * state_size,))
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional):
+    return sum(int(_np.prod(s)) for _, _, _, s in _layer_param_shapes(
+        mode, input_size, state_size, num_layers, bidirectional))
+
+
+def rnn_unpack_params(params, mode, input_size, state_size, num_layers,
+                      bidirectional):
+    """Flat vector -> dict {(layer, dir, name): array}."""
+    out = {}
+    off = 0
+    for layer, d, name, shape in _layer_param_shapes(
+            mode, input_size, state_size, num_layers, bidirectional):
+        n = int(_np.prod(shape))
+        out[(layer, d, name)] = params[off:off + n].reshape(shape)
+        off += n
+    return out
+
+
+def _cell_step(mode, xw, h, c, w_hh, b_hh):
+    """One timestep given precomputed input projection xw."""
+    H = h.shape[-1]
+    gates = xw + jnp.dot(h, w_hh.T) + b_hh
+    if mode == "rnn_relu":
+        return jnp.maximum(gates, 0), None
+    if mode == "rnn_tanh":
+        return jnp.tanh(gates), None
+    if mode == "lstm":
+        i = jax.nn.sigmoid(gates[..., 0:H])
+        f = jax.nn.sigmoid(gates[..., H:2 * H])
+        g = jnp.tanh(gates[..., 2 * H:3 * H])
+        o = jax.nn.sigmoid(gates[..., 3 * H:4 * H])
+        new_c = f * c + i * g
+        new_h = o * jnp.tanh(new_c)
+        return new_h, new_c
+    if mode == "gru":
+        # r,z share the fused projection; candidate needs separate h2h term
+        xr, xz, xn = xw[..., 0:H], xw[..., H:2 * H], xw[..., 2 * H:3 * H]
+        hr = jnp.dot(h, w_hh[0:H].T) + b_hh[0:H]
+        hz = jnp.dot(h, w_hh[H:2 * H].T) + b_hh[H:2 * H]
+        hn = jnp.dot(h, w_hh[2 * H:3 * H].T) + b_hh[2 * H:3 * H]
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        new_h = (1 - z) * n + z * h
+        return new_h, None
+    raise MXNetError("unknown RNN mode %s" % mode)
+
+
+def _run_layer(mode, x, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse):
+    """Scan one direction of one layer.  x: (T, N, I)."""
+    # hoist the input projection out of the scan: one MXU matmul for all T
+    xw = jnp.einsum("tni,gi->tng", x, w_ih) + b_ih
+    if reverse:
+        xw = jnp.flip(xw, axis=0)
+
+    if mode == "lstm":
+        def step(carry, xw_t):
+            h, c = carry
+            new_h, new_c = _cell_step(mode, xw_t, h, c, w_hh, b_hh)
+            return (new_h, new_c), new_h
+        (hT, cT), out = jax.lax.scan(step, (h0, c0), xw)
+    else:
+        def step(h, xw_t):
+            new_h, _ = _cell_step(mode, xw_t, h, None, w_hh, b_hh)
+            return new_h, new_h
+        hT, out = jax.lax.scan(step, h0, xw)
+        cT = None
+    if reverse:
+        out = jnp.flip(out, axis=0)
+    return out, hT, cT
+
+
+def _rnn_args(attrs):
+    args = ["data", "parameters", "state"]
+    if attrs.get("mode", "lstm") == "lstm":
+        args.append("state_cell")
+    return args
+
+
+def _rnn_num_outputs(attrs):
+    n = 1
+    if attrs.get("state_outputs", False):
+        n += 2 if attrs.get("mode", "lstm") == "lstm" else 1
+    return n
+
+
+def _rnn_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, [None] * _rnn_num_outputs(attrs), None
+    T, N, I = data
+    H = int(attrs["state_size"])
+    L = int(attrs["num_layers"])
+    bi = attrs.get("bidirectional", False)
+    ndir = 2 if bi else 1
+    psize = rnn_param_size(attrs.get("mode", "lstm"), I, H, L, bi)
+    ins = list(in_shapes)
+    ins[1] = (psize,)
+    ins[2] = (L * ndir, N, H)
+    if len(ins) > 3:
+        ins[3] = (L * ndir, N, H)
+    outs = [(T, N, H * ndir)]
+    if attrs.get("state_outputs", False):
+        outs.append((L * ndir, N, H))
+        if attrs.get("mode", "lstm") == "lstm":
+            outs.append((L * ndir, N, H))
+    return ins, outs, None
+
+
+@register("RNN", arg_names=_rnn_args, num_outputs=_rnn_num_outputs,
+          attr_types={"state_size": parse_int, "num_layers": parse_int,
+                      "bidirectional": parse_bool, "mode": parse_str,
+                      "p": parse_float, "state_outputs": parse_bool,
+                      "pkeep_": parse_float},
+          defaults={"bidirectional": False, "mode": "lstm", "p": 0.0,
+                    "state_outputs": False},
+          infer_shape=_rnn_infer, needs_rng=True, train_aware=True)
+def _rnn(data, parameters, state, state_cell=None, rng=None, is_train=False,
+         state_size=None, num_layers=1, bidirectional=False, mode="lstm",
+         p=0.0, state_outputs=False, pkeep_=None):
+    """Fused multi-layer (bi)RNN/LSTM/GRU over a full sequence."""
+    T, N, I = data.shape
+    H = state_size
+    ndir = 2 if bidirectional else 1
+    wd = rnn_unpack_params(parameters, mode, I, H, num_layers, bidirectional)
+    x = data
+    h_states, c_states = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(ndir):
+            idx = layer * ndir + d
+            h0 = state[idx]
+            c0 = state_cell[idx] if mode == "lstm" else None
+            out, hT, cT = _run_layer(
+                mode, x, h0, c0,
+                wd[(layer, d, "i2h_weight")], wd[(layer, d, "h2h_weight")],
+                wd[(layer, d, "i2h_bias")], wd[(layer, d, "h2h_bias")],
+                reverse=(d == 1))
+            outs.append(out)
+            h_states.append(hT)
+            if mode == "lstm":
+                c_states.append(cT)
+        x = outs[0] if ndir == 1 else jnp.concatenate(outs, axis=-1)
+        if is_train and p > 0.0 and layer < num_layers - 1:
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(rng, layer), keep, x.shape)
+            x = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    if not state_outputs:
+        return x
+    hN = jnp.stack(h_states, axis=0)
+    if mode == "lstm":
+        cN = jnp.stack(c_states, axis=0)
+        return x, hN, cN
+    return x, hN

@@ -1,0 +1,424 @@
+"""Optimizers (parity: reference python/mxnet/optimizer.py:10-698).
+
+All ten reference optimizers, implemented over the fused update ops in
+ops/optimizer_ops.py where one exists (SGD/Adam/RMSProp families run as single
+XLA computations per weight) and plain NDArray math otherwise.  The ``Updater``
+closure carries per-key state exactly like the reference so kvstore
+``set_updater``/server-side updates work the same way.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as np
+
+from .base import MXNetError, Registry, string_types
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "DCASGD", "Adam",
+           "AdaGrad", "RMSProp", "AdaDelta", "Test", "Updater", "create",
+           "get_updater", "register"]
+
+_OPTIMIZERS = Registry("optimizer")
+
+
+def register(klass):
+    """Register an optimizer class by lowercase name (parity: Optimizer.register)."""
+    _OPTIMIZERS.register(klass.__name__.lower(), klass, override=True)
+    return klass
+
+
+class Optimizer(object):
+    """Base optimizer (parity: optimizer.py Optimizer)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            "param_idx2name should be a dict of param indexes to names."
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        return create(name, **kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def set_lr_scale(self, args_lrscale):  # deprecated in reference too
+        raise DeprecationWarning("Use set_lr_mult instead.")
+
+    def set_lr_mult(self, args_lr_mult):
+        """Per-arg lr multipliers; also reads __lr_mult__ symbol attrs."""
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        """Per-arg wd multipliers; bias/gamma/beta default to 0 like reference."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    # kvstore-server transport (parity: python/mxnet/kvstore.py set_optimizer)
+    def dumps(self):
+        return pickle.dumps(self)
+
+    @staticmethod
+    def loads(buf):
+        return pickle.loads(buf)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum via the fused sgd(_mom)_update ops (parity: SGD)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        assert isinstance(weight, NDArray)
+        assert isinstance(grad, NDArray)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=-1.0 if self.clip_gradient is None
+                      else self.clip_gradient)
+        if state is not None:
+            new_w, new_m = nd.sgd_mom_update(weight, grad, state,
+                                             momentum=self.momentum, **kwargs)
+            weight._set_value(new_w.value)
+            state._set_value(new_m.value)
+        else:
+            new_w = nd.sgd_update(weight, grad, **kwargs)
+            weight._set_value(new_w.value)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (parity: NAG)."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        if state is not None:
+            mom = state
+            mom *= self.momentum
+            grad += wd * weight
+            mom += grad
+            grad += self.momentum * mom
+            weight += -lr * grad
+        else:
+            assert self.momentum == 0.0
+            weight += -lr * (grad + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (parity: SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        noise = nd.normal(loc=0.0, scale=math.sqrt(lr), shape=weight.shape,
+                          ctx=weight.context)
+        weight += -lr / 2 * (grad + wd * weight) + noise
+
+
+@register
+class ccSGD(SGD):
+    """Alias of SGD (the reference's C++-impl SGD; same math on TPU)."""
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (parity: DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        mon, previous_weight = state
+        if mon is not None:
+            mon *= self.momentum
+            mon += -lr * (grad + wd * weight + self.lamda *
+                          grad * grad * (weight - previous_weight))
+        else:
+            mon = -lr * (grad + wd * weight + self.lamda *
+                         grad * grad * (weight - previous_weight))
+        previous_weight._set_value(weight.value)
+        weight += mon
+
+
+@register
+class Adam(Optimizer):
+    """Adam via the fused adam_update op with bias-corrected lr (parity: Adam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        new_w, new_mean, new_var = nd.adam_update(
+            weight, grad, mean, var, lr=lr, beta1=self.beta1, beta2=self.beta2,
+            epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad,
+            clip_gradient=-1.0 if self.clip_gradient is None
+            else self.clip_gradient)
+        weight._set_value(new_w.value)
+        mean._set_value(new_mean.value)
+        var._set_value(new_var.value)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (parity: AdaGrad)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        history = state
+        history += grad * grad
+        weight += -lr * (grad / nd.sqrt(history + self.float_stable_eps)
+                         + wd * weight)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, Tieleman (centered=False) or Graves (centered=True) variant,
+    via the fused rmsprop ops (parity: RMSProp)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (nd.zeros(weight.shape, weight.context),
+                    nd.zeros(weight.shape, weight.context),
+                    nd.zeros(weight.shape, weight.context))
+        return (nd.zeros(weight.shape, weight.context),)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      gamma1=self.gamma1, epsilon=self.epsilon,
+                      clip_gradient=-1.0 if self.clip_gradient is None
+                      else self.clip_gradient,
+                      clip_weights=-1.0 if self.clip_weights is None
+                      else self.clip_weights)
+        if not self.centered:
+            (n,) = state
+            new_w, new_n = nd.rmsprop_update(weight, grad, n, **kwargs)
+            weight._set_value(new_w.value)
+            n._set_value(new_n.value)
+        else:
+            n, g, delta = state
+            new_w, new_n, new_g, new_d = nd.rmspropalex_update(
+                weight, grad, n, g, delta, gamma2=self.gamma2, **kwargs)
+            weight._set_value(new_w.value)
+            n._set_value(new_n.value)
+            g._set_value(new_g.value)
+            delta._set_value(new_d.value)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (parity: AdaDelta)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.context),
+                nd.zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, a_min=-self.clip_gradient,
+                           a_max=self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g._set_value((self.rho * acc_g + (1.0 - self.rho) * grad
+                          * grad).value)
+        current_delta = (nd.sqrt(acc_delta + self.epsilon)
+                         / nd.sqrt(acc_g + self.epsilon)) * grad
+        acc_delta._set_value((self.rho * acc_delta + (1.0 - self.rho)
+                              * current_delta * current_delta).value)
+        weight._set_value((weight - current_delta - wd * weight).value)
+
+
+@register
+class Test(Optimizer):
+    """Trivial optimizer for tests (parity: Test)."""
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+        state._set_value(weight.value)
+
+
+def create(name, rescale_grad=1.0, **kwargs):
+    """Create optimizer by registered name (parity: opt.create)."""
+    if isinstance(name, Optimizer):
+        return name
+    if isinstance(name, string_types):
+        klass = _OPTIMIZERS.find(name.lower())
+        if klass is None:
+            raise MXNetError("unknown optimizer %s" % name)
+        return klass(rescale_grad=rescale_grad, **kwargs)
+    raise MXNetError("invalid optimizer spec %r" % (name,))
+
+
+class Updater(object):
+    """Closure applying an optimizer with per-key states (parity: Updater)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        self.states = pickle.loads(states)
+
+    def get_states(self):
+        return pickle.dumps(self.states)
+
+
+def get_updater(optimizer):
+    """(parity: get_updater)"""
+    return Updater(optimizer)

@@ -1,0 +1,11 @@
+"""Parallelism substrate (TPU-native; SURVEY.md §2.6/§5.7/§5.8).
+
+- ``mesh``: device-mesh helpers (dp/tp/pp/sp axes) over jax.sharding.Mesh
+- ``dist``: multi-host runtime (rank/size/allreduce/barrier) — the ps-lite/
+  tracker replacement built on jax.distributed + XLA collectives over ICI/DCN
+- ``sharded``: sharded training-step builder (data/tensor parallel pjit)
+- ``ring``: ring attention / sequence parallelism (new capability; the
+  reference has none — SURVEY.md §5.7)
+"""
+from . import dist
+from . import mesh

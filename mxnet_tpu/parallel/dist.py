@@ -1,0 +1,71 @@
+"""Multi-host distributed runtime (parity: ps-lite + dmlc tracker roles,
+SURVEY.md §2.6; replaced by jax.distributed + XLA collectives over ICI/DCN).
+
+Environment contract (replaces DMLC_ROLE/DMLC_PS_ROOT_URI):
+- ``MXTPU_COORDINATOR``   address of process 0 (host:port)
+- ``MXTPU_NUM_PROCESSES`` world size
+- ``MXTPU_PROCESS_ID``    this process's rank
+A single process with no env vars set runs standalone (rank 0 of 1) — the same
+code path the reference's `local` tracker exercises.
+
+Worker-death detection (parity: KVStore::get_num_dead_node via ps heartbeats) is
+delegated to the JAX coordination service: a missing host fails the collective,
+and recovery is checkpoint-resume (SURVEY.md §5.3 notes the PS hot-state model
+is intentionally replaced by checkpointing).
+"""
+from __future__ import annotations
+
+import os
+
+from ..base import get_env
+
+_initialized = False
+
+
+def init_process_group():
+    """Initialize jax.distributed from the MXTPU_* env contract (idempotent)."""
+    global _initialized
+    if _initialized:
+        return
+    coord = get_env("MXTPU_COORDINATOR")
+    nproc = get_env("MXTPU_NUM_PROCESSES", typ=int)
+    pid = get_env("MXTPU_PROCESS_ID", typ=int)
+    if coord and nproc and nproc > 1:
+        import jax
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=pid or 0)
+    _initialized = True
+
+
+def rank():
+    init_process_group()
+    import jax
+    return jax.process_index()
+
+
+def num_workers():
+    init_process_group()
+    import jax
+    return jax.process_count()
+
+
+def barrier(name="kvstore"):
+    """Global barrier via the coordination service (parity: ps barrier)."""
+    init_process_group()
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
+def allreduce(value):
+    """Sum an NDArray across worker processes (psum over the global mesh;
+    parity: the dist kvstore server-side merge)."""
+    init_process_group()
+    import jax
+    if jax.process_count() <= 1:
+        return value
+    from jax.experimental import multihost_utils
+    from .. import ndarray as nd
+    summed = multihost_utils.process_allgather(value.value)
+    return nd.NDArray(summed.sum(axis=0), ctx=value.context)

@@ -1,0 +1,55 @@
+"""Device-mesh helpers (TPU-native core; the scaling-book recipe: pick a mesh,
+annotate shardings, let XLA insert collectives).
+
+Axis conventions used throughout mxnet_tpu:
+- ``dp``  data parallel (batch dimension)
+- ``tp``  tensor/model parallel (hidden dimension)
+- ``pp``  pipeline stages
+- ``sp``  sequence/context parallel (ring attention)
+- ``ep``  expert parallel
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["make_mesh", "data_parallel_mesh", "local_devices_for"]
+
+
+def local_devices_for(ctx_list=None):
+    """Map a list of Contexts to jax devices (defaults to all local devices)."""
+    import jax
+    if not ctx_list:
+        return jax.local_devices()
+    return [c.jax_device() for c in ctx_list]
+
+
+def make_mesh(axes, devices=None):
+    """Build a Mesh from {axis_name: size}; -1 infers one axis from the device
+    count.  Example: make_mesh({'dp': -1, 'tp': 2})."""
+    import jax
+    from jax.sharding import Mesh
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(_np.prod([s for s in sizes if s != -1])) or 1
+        if n % known:
+            raise MXNetError("cannot infer mesh axis: %d devices, known %d"
+                             % (n, known))
+        sizes[sizes.index(-1)] = n // known
+    if int(_np.prod(sizes)) != n:
+        raise MXNetError("mesh %r does not cover %d devices"
+                         % (dict(zip(names, sizes)), n))
+    dev_array = _np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def data_parallel_mesh(ctx_list=None):
+    """1-D dp mesh over the given contexts (kvstore local/device backing)."""
+    import jax
+    from jax.sharding import Mesh
+    devs = local_devices_for(ctx_list)
+    return Mesh(_np.asarray(devs), ("dp",))

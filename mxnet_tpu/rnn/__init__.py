@@ -1,0 +1,5 @@
+"""RNN toolkit (parity: reference python/mxnet/rnn/)."""
+from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell, FusedRNNCell,
+                       SequentialRNNCell, BidirectionalCell, DropoutCell,
+                       ZoneoutCell, ResidualCell, ModifierCell, RNNParams)
+from .io import BucketSentenceIter, encode_sentences

@@ -1,0 +1,249 @@
+"""Test helpers (parity: reference python/mxnet/test_utils.py:128-883).
+
+Provides the same checking toolkit the reference test-suite is built on:
+numeric-gradient checking, symbolic forward/backward checking against numpy,
+and multi-context consistency checking (the reference's CPU/GPU consistency
+becomes CPU/TPU + multi-device consistency here).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from . import ndarray as nd
+from . import symbol as sym_mod
+
+__all__ = ["default_context", "assert_almost_equal", "almost_equal",
+           "check_numeric_gradient", "check_symbolic_forward",
+           "check_symbolic_backward", "check_consistency", "rand_ndarray",
+           "numeric_grad", "reldiff", "same", "random_arrays"]
+
+default_dtype = np.float32
+
+
+def default_context():
+    return current_context()
+
+
+def random_arrays(*shapes):
+    """Random float32 arrays in [-1, 1)."""
+    arrays = [np.random.uniform(-1.0, 1.0, s).astype(default_dtype)
+              for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def rand_ndarray(shape, ctx=None):
+    return nd.array(np.random.uniform(-1.0, 1.0, shape), ctx=ctx)
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def reldiff(a, b):
+    """(parity: test_utils.reldiff)"""
+    diff = np.sum(np.abs(a - b))
+    norm = np.sum(np.abs(a)) + np.sum(np.abs(b))
+    if diff == 0:
+        return 0
+    return diff / norm
+
+
+def almost_equal(a, b, rtol=None, atol=None):
+    rtol = 1e-5 if rtol is None else rtol
+    atol = 1e-20 if atol is None else atol
+    return np.allclose(a, b, rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    """(parity: test_utils.assert_almost_equal:128)"""
+    rtol = 1e-5 if rtol is None else rtol
+    atol = 1e-20 if atol is None else atol
+    if not np.allclose(a, b, rtol=rtol, atol=atol):
+        index = np.unravel_index(np.argmax(np.abs(a - b)), a.shape)
+        relerr = np.max(np.abs(a - b) / (np.abs(b) + atol))
+        raise AssertionError(
+            "Items are not equal:\nError %f exceeds tolerance rtol=%f, "
+            "atol=%f. Location of maximum error:%s, %s=%f, %s=%f"
+            % (relerr, rtol, atol, str(index), names[0], a[index], names[1],
+               b[index]))
+
+
+def _parse_location(sym, location, ctx):
+    if isinstance(location, dict):
+        if set(location.keys()) != set(sym.list_arguments()):
+            raise ValueError(
+                "Symbol arguments and keys of the given location do not match."
+                "symbol args:%s, location.keys():%s"
+                % (str(set(sym.list_arguments())),
+                   str(set(location.keys()))))
+    else:
+        location = dict(zip(sym.list_arguments(), location))
+    return {k: nd.array(v, ctx=ctx) if not isinstance(v, nd.NDArray) else v
+            for k, v in location.items()}
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Finite-difference gradients (parity: test_utils.numeric_grad)."""
+    approx_grads = {k: np.zeros(v.shape, dtype=np.float32)
+                    for k, v in location.items()}
+    for k, v in location.items():
+        executor.arg_dict[k][:] = v
+    for k in location:
+        old_value = location[k].copy()
+        for i in range(int(np.prod(old_value.shape))):
+            # inplace update
+            flat = old_value.reshape(-1)
+            orig = flat[i]
+            flat[i] = orig + eps
+            executor.arg_dict[k][:] = old_value.reshape(old_value.shape)
+            executor.forward(is_train=use_forward_train)
+            f_peps = executor.outputs[0].asnumpy().sum()
+            flat[i] = orig - eps
+            executor.arg_dict[k][:] = old_value.reshape(old_value.shape)
+            executor.forward(is_train=use_forward_train)
+            f_neps = executor.outputs[0].asnumpy().sum()
+            flat[i] = orig
+            approx_grads[k].reshape(-1)[i] = (f_peps - f_neps) / (2 * eps)
+        executor.arg_dict[k][:] = old_value.reshape(old_value.shape)
+    return approx_grads
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None,
+                           use_forward_train=True, ctx=None):
+    """Finite differences vs autodiff gradients (parity:
+    test_utils.check_numeric_gradient:359)."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    location_npy = {k: v.asnumpy() for k, v in location.items()}
+    if grad_nodes is None:
+        grad_nodes = sym.list_arguments()
+    grad_req = {k: "write" if k in grad_nodes else "null"
+                for k in sym.list_arguments()}
+    args_grad = {k: nd.zeros(location[k].shape, ctx=ctx) for k in grad_nodes}
+    executor = sym.bind(ctx, args=location, args_grad=args_grad,
+                        grad_req=grad_req)
+    executor.forward(is_train=use_forward_train)
+    assert len(executor.outputs) == 1
+    executor.backward()
+    symbolic_grads = {k: executor.grad_dict[k].asnumpy() for k in grad_nodes}
+    numeric_gradients = numeric_grad(executor, location_npy,
+                                     eps=numeric_eps,
+                                     use_forward_train=use_forward_train)
+    for name in grad_nodes:
+        fd_grad = numeric_gradients[name]
+        sym_grad = symbolic_grads[name]
+        rel = reldiff(fd_grad, sym_grad)
+        if rel > rtol:
+            raise AssertionError(
+                "numeric gradient check failed for %s: reldiff %f > %f\n"
+                "numeric:\n%s\nsymbolic:\n%s"
+                % (name, rel, rtol, fd_grad, sym_grad))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=None,
+                           aux_states=None, ctx=None):
+    """(parity: test_utils.check_symbolic_forward:472)"""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    executor = sym.bind(ctx, args=location, grad_req="null")
+    outputs = [o.asnumpy() for o in executor.forward()]
+    for out, exp in zip(outputs, expected):
+        assert_almost_equal(out, exp, rtol=rtol,
+                            atol=atol if atol is not None else 1e-8)
+    return outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None):
+    """(parity: test_utils.check_symbolic_backward:526)"""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    expected = expected if isinstance(expected, dict) else \
+        dict(zip(sym.list_arguments(), expected))
+    args_grad = {k: nd.zeros(v.shape, ctx=ctx)
+                 for k, v in location.items() if k in expected}
+    grad_reqs = {k: grad_req if k in expected else "null"
+                 for k in sym.list_arguments()}
+    executor = sym.bind(ctx, args=location, args_grad=args_grad,
+                        grad_req=grad_reqs)
+    executor.forward(is_train=True)
+    ogs = [nd.array(g, ctx=ctx) if not isinstance(g, nd.NDArray) else g
+           for g in (out_grads if isinstance(out_grads, (list, tuple))
+                     else [out_grads])]
+    executor.backward(ogs)
+    grads = {k: v.asnumpy() for k, v in args_grad.items()}
+    for name, exp in expected.items():
+        assert_almost_equal(grads[name], exp, rtol=rtol,
+                            atol=atol if atol is not None else 1e-8)
+    return grads
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, tol=None,
+                      raise_on_err=True):
+    """Run one symbol under several contexts/dtypes and cross-compare outputs
+    and gradients (parity: test_utils.check_consistency:676 — the CPU/GPU
+    consistency driver, repurposed for CPU/TPU/multi-device)."""
+    tol = tol or {np.dtype(np.float16): 1e-1, np.dtype(np.float32): 1e-3,
+                  np.dtype(np.float64): 1e-5, np.dtype(np.uint8): 0,
+                  np.dtype(np.int32): 0}
+    assert len(ctx_list) > 1
+    if isinstance(sym, sym_mod.Symbol):
+        sym = [sym] * len(ctx_list)
+    else:
+        assert len(sym) == len(ctx_list)
+    output_points = None
+    exe_list = []
+    for s, ctx in zip(sym, ctx_list):
+        ctx = dict(ctx)
+        ctx_ctx = ctx.pop("ctx", cpu())
+        type_dict = ctx.pop("type_dict", {})
+        exe_list.append(s.simple_bind(ctx=ctx_ctx, grad_req=grad_req,
+                                      type_dict=type_dict, **ctx))
+    arg_params = arg_params or {}
+    aux_params = aux_params or {}
+    # init with shared random values
+    for name, arr in exe_list[0].arg_dict.items():
+        if name not in arg_params:
+            arg_params[name] = np.random.normal(
+                size=arr.shape, scale=scale).astype(np.float32)
+    for name, arr in exe_list[0].aux_dict.items():
+        if name not in aux_params:
+            aux_params[name] = 0
+    for exe in exe_list:
+        for name, arr in exe.arg_dict.items():
+            arr[:] = arg_params[name].astype(np.asarray(arr.asnumpy()).dtype)
+        for name, arr in exe.aux_dict.items():
+            arr[:] = aux_params[name]
+        exe.forward(is_train=grad_req != "null")
+        if grad_req != "null":
+            exe.backward(exe.outputs)
+    dtypes = [np.asarray(e.outputs[0].asnumpy()).dtype for e in exe_list]
+    max_idx = np.argmax([np.dtype(d).itemsize for d in dtypes])
+    gt = {n: v.asnumpy() for n, v in exe_list[max_idx].arg_dict.items()}
+    gt.update({"__output__%d" % i: o.asnumpy()
+               for i, o in enumerate(exe_list[max_idx].outputs)})
+    for i, exe in enumerate(exe_list):
+        if i == max_idx:
+            continue
+        rtol = tol[np.dtype(dtypes[i])]
+        for j, o in enumerate(exe.outputs):
+            assert_almost_equal(o.asnumpy().astype(np.float64),
+                                gt["__output__%d" % j].astype(np.float64),
+                                rtol=rtol, atol=rtol)
+        if grad_req != "null":
+            for name, arr in exe.grad_dict.items():
+                if arr is None:
+                    continue
+                gt_arr = exe_list[max_idx].grad_dict[name].asnumpy()
+                assert_almost_equal(arr.asnumpy().astype(np.float64),
+                                    gt_arr.astype(np.float64),
+                                    rtol=rtol, atol=rtol)
+    return gt

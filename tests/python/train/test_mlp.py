@@ -1,0 +1,97 @@
+"""End-to-end convergence tests (parity model: reference
+tests/python/train/test_mlp.py / test_conv.py — train a few epochs on a small
+problem and assert accuracy).  Uses synthetic separable data (no dataset
+downloads in the sandbox)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def make_blobs(num=1000, num_classes=10, dim=64, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(num_classes, dim) * 3
+    labels = rng.randint(0, num_classes, num)
+    data = centers[labels] + rng.randn(num, dim)
+    return data.astype(np.float32), labels.astype(np.float32)
+
+
+def test_mlp_training_converges():
+    data, labels = make_blobs()
+    train = mx.io.NDArrayIter(data[:800], labels[:800], batch_size=50,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(data[800:], labels[800:], batch_size=50)
+    net = models.get_mlp()
+    mod = mx.Module(net, context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            num_epoch=6)
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9, "mlp accuracy %f too low" % score[0][1]
+
+
+def test_lenet_training_converges():
+    """The minimum end-to-end slice (SURVEY.md §7 step 6): LeNet + Conv/Pool/
+    Activation/FC/SoftmaxOutput + SGD + Module.fit + Accuracy."""
+    rng = np.random.RandomState(3)
+    num, nc = 600, 4
+    # synthetic 'digits': distinct frequency patterns per class
+    xs = np.zeros((num, 1, 28, 28), dtype=np.float32)
+    ys = rng.randint(0, nc, num).astype(np.float32)
+    grid = np.stack(np.meshgrid(np.arange(28), np.arange(28)), 0)
+    for i in range(num):
+        k = int(ys[i]) + 1
+        xs[i, 0] = np.sin(grid[0] * k * 0.3) + np.cos(grid[1] * k * 0.3)
+    xs += rng.randn(*xs.shape).astype(np.float32) * 0.1
+    train = mx.io.NDArrayIter(xs[:500], ys[:500], batch_size=50, shuffle=True)
+    val = mx.io.NDArrayIter(xs[500:], ys[500:], batch_size=50)
+    net = models.get_lenet(num_classes=nc)
+    mod = mx.Module(net, context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            num_epoch=4)
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9, "lenet accuracy %f too low" % score[0][1]
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    data, labels = make_blobs(num=200, num_classes=4, dim=16, seed=1)
+    train = mx.io.NDArrayIter(data, labels, batch_size=20)
+    net = models.get_mlp(num_classes=4)
+    mod = mx.Module(net, context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1}, num_epoch=2)
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 2)
+    # reload and check predictions identical
+    mod2 = mx.Module.load(prefix, 2)
+    val = mx.io.NDArrayIter(data, labels, batch_size=20)
+    mod2.bind(data_shapes=val.provide_data, label_shapes=val.provide_label,
+              for_training=False)
+    preds1 = mod.predict(val).asnumpy()
+    val.reset()
+    preds2 = mod2.predict(val).asnumpy()
+    np.testing.assert_allclose(preds1, preds2, rtol=1e-5)
+
+
+def test_multi_device_data_parallel():
+    """Data-parallel training across 4 virtual devices matches single-device
+    (parity model: tests/nightly/multi_lenet.py idea, shrunk)."""
+    data, labels = make_blobs(num=400, num_classes=4, dim=32, seed=2)
+    net = models.get_mlp(num_classes=4)
+
+    def train_with(ctxs, kv):
+        mx.random.seed(42)
+        train = mx.io.NDArrayIter(data, labels, batch_size=40)
+        mod = mx.Module(net, context=ctxs)
+        mod.fit(train, optimizer="sgd", kvstore=kv,
+                optimizer_params={"learning_rate": 0.1}, num_epoch=3,
+                initializer=mx.initializer.Xavier(rnd_type="gaussian"))
+        val = mx.io.NDArrayIter(data, labels, batch_size=40)
+        return mod.score(val, "acc")[0][1], mod.get_params()[0]
+
+    acc1, _ = train_with([mx.cpu(0)], "local")
+    acc4, _ = train_with([mx.cpu(0), mx.cpu(1), mx.cpu(2), mx.cpu(3)],
+                         "device")
+    assert acc1 > 0.9
+    assert acc4 > 0.9
