@@ -1,0 +1,64 @@
+"""Benchmark harness (parity: reference example/image-classification/
+benchmark_score.py + train_imagenet.py --benchmark 1).
+
+Trains ResNet-50 batch-32 on synthetic ImageNet-shaped data with the fused
+SPMD TrainStep (one donated XLA computation per step: forward + backward +
+SGD update) and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+
+vs_baseline is measured against the strongest published reference number:
+ResNet-50 train 181.53 img/s on P100 (reference docs/how_to/perf.md:128-137).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_resnet50_train(batch=32, image=224, warmup=3, iters=30,
+                         dtype="bfloat16"):
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import resnet
+    from mxnet_tpu.train import TrainStep
+
+    net = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape="3,%d,%d" % (image, image))
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           rescale_grad=1.0 / batch, wd=1e-4)
+    ts = TrainStep(net, opt, dtype=dtype)
+    params, state, aux = ts.init(
+        {"data": (batch, 3, image, image)}, {"softmax_label": (batch,)})
+
+    rng = np.random.RandomState(0)
+    data = rng.uniform(-1, 1, (batch, 3, image, image)).astype(np.float32)
+    label = rng.randint(0, 1000, (batch,)).astype(np.float32)
+    batch_dev = ts.shard_batch({"data": data, "softmax_label": label})
+
+    for _ in range(warmup):
+        params, state, aux, outs = ts(params, state, aux, batch_dev)
+    jax.block_until_ready(outs)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, state, aux, outs = ts(params, state, aux, batch_dev)
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+    return batch * iters / dt
+
+
+def main():
+    img_per_sec = bench_resnet50_train()
+    baseline_p100 = 181.53
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec_b32",
+        "value": round(img_per_sec, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_per_sec / baseline_p100, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

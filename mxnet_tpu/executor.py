@@ -120,17 +120,26 @@ class Executor(object):
                 self.grad_dict.pop(n, None)
 
         # pre-allocate output NDArrays (in-place updated on every forward,
-        # parity: GraphExecutor output arrays)
+        # parity: GraphExecutor output arrays).  Output dtypes follow from the
+        # bound argument dtypes (infer_type), so bfloat16/float16 networks get
+        # matching cotangent dtypes in the fused fwd+bwd.
         shapes = {n: a.shape for n, a in self.arg_dict.items()}
         _, out_shapes, _ = symbol.infer_shape_partial(**shapes)
-        types = {n: a.dtype for n, a in self.arg_dict.items()
-                 if -1 not in a.shape}
+        types = {n: a.dtype for n, a in self.arg_dict.items()}
+        try:
+            _, out_types, _ = symbol.infer_type(**types)
+        except Exception:
+            out_types = [None] * len(out_shapes)
         self._output_nds = []
-        for s in out_shapes:
-            self._output_nds.append(nd.zeros(s if s else (1,), ctx=self._ctx))
+        for s, t in zip(out_shapes, out_types):
+            self._output_nds.append(
+                nd.zeros(s if s else (1,), ctx=self._ctx,
+                         dtype=t if t is not None else _np.float32))
         self._jit_cache = {}
         self._monitor_cb = None
         self._cached_grads = None
+        self._last_rng = None
+        self._warned_default_heads = False
         self._multi_device = self._detect_multi_device()
 
     # ------------------------------------------------------------- bind utils
@@ -208,12 +217,18 @@ class Executor(object):
                     grads[name] = shared_grads[name]
                 else:
                     grads[name] = nd.zeros(shape, ctx=c, dtype=dt)
+        try:
+            _, _, aux_types = symbol.infer_type(
+                **{n: arg_types.get(n, _np.float32) for n in arg_names})
+        except Exception:
+            aux_types = [None] * len(aux_names)
         auxs = {}
-        for name, shape in zip(aux_names, aux_shapes):
+        for name, shape, at in zip(aux_names, aux_shapes, aux_types):
             if name in shared_aux and shared_aux[name].shape == shape:
                 auxs[name] = shared_aux[name]
             else:
-                auxs[name] = nd.zeros(shape, ctx=ctx)
+                auxs[name] = nd.zeros(shape, ctx=ctx,
+                                      dtype=at if at is not None else _np.float32)
         return Executor(symbol, ctx, args, grads, grad_req, auxs,
                         group2ctx=group2ctx, shared_exec=shared_exec)
 
@@ -269,6 +284,30 @@ class Executor(object):
         self._jit_cache[kind] = fn
         return fn
 
+    def _check_default_heads(self):
+        """Warn when implicit all-ones head gradients reach non-loss outputs
+        (the reference errors unless every head is a loss op whose backward
+        ignores the head gradient — ADVICE r1)."""
+        if self._warned_default_heads:
+            return
+        def exempt(node):
+            # loss heads define their own backward; BlockGrad's is identically
+            # zero — implicit ones are harmless for both
+            if node.is_var:
+                return False
+            return getattr(node.op, "is_loss", False) or \
+                node.op.name == "BlockGrad"
+        bad = [node.name for node, _ in self._symbol._outputs
+               if not exempt(node)]
+        if bad:
+            import warnings
+            warnings.warn(
+                "backward() without out_grads on non-loss output(s) %s: "
+                "gradients use implicit all-ones head gradients (the "
+                "reference requires explicit out_grads here)" % bad,
+                stacklevel=3)
+        self._warned_default_heads = True
+
     def _arg_values(self):
         return {n: a.value for n, a in self.arg_dict.items()}
 
@@ -287,6 +326,7 @@ class Executor(object):
             else:
                 self.arg_dict[k][:] = v
         rng = _random.next_key()
+        self._last_rng = rng
         self._cached_grads = None
         if self._multi_device:
             outs, aux_upd = self._forward_eager(is_train, rng)
@@ -319,10 +359,11 @@ class Executor(object):
         gnames = self._grad_arg_names()
         if not gnames:
             return
+        if out_grads is None:
+            self._check_default_heads()
         if out_grads is None and self._cached_grads is not None:
             grads = self._cached_grads
         else:
-            import jax
             if out_grads is None:
                 ogs = [_ones_like_val(o) for o in self._output_nds]
             else:
@@ -333,8 +374,12 @@ class Executor(object):
             gargs = {n: argv[n] for n in gnames}
             oargs = {n: v for n, v in argv.items() if n not in gargs}
             fn = self._get_jit("fused")
-            _, _, grads = fn(gargs, oargs, self._aux_values(),
-                             _random.next_key(), ogs)
+            # Reuse the forward pass's RNG key so stochastic ops (Dropout,
+            # rrelu) see the same masks the caller's out_grads were computed
+            # against (parity: the reference reuses the stored forward masks).
+            rng = self._last_rng if self._last_rng is not None \
+                else _random.next_key()
+            _, _, grads = fn(gargs, oargs, self._aux_values(), rng, ogs)
         for name in gnames:
             req = self.grad_req[name]
             tgt = self.grad_dict[name]

@@ -116,7 +116,16 @@ def _init_data(data, allow_empty, default_name):
 
 
 class NDArrayIter(DataIter):
-    """Iterate over in-memory arrays (parity: io.NDArrayIter:118)."""
+    """Iterate over in-memory arrays.
+
+    TPU-first design: instead of walking a cursor through the arrays, each
+    epoch is a precomputed *gather schedule* — a list of ``(indices, pad)``
+    batches built once per reset.  Every batch is then a single fancy-index
+    gather (one XLA-friendly contiguous copy), padding wraps indices to the
+    epoch start, and ``roll_over`` carries the unscheduled tail into the next
+    epoch's first batch.  Capability parity with reference io.NDArrayIter
+    (python/mxnet/io.py); mechanism is original.
+    """
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
@@ -126,70 +135,81 @@ class NDArrayIter(DataIter):
         self.label = _init_data(label, allow_empty=True,
                                 default_name=label_name)
         self.num_data = self.data[0][1].shape[0]
-        assert self.num_data >= batch_size, \
-            "batch_size needs to be smaller than data size."
-        if shuffle:
-            idx = np.arange(self.num_data)
-            np.random.shuffle(idx)
-            self.data = [(k, v[idx]) for k, v in self.data]
-            self.label = [(k, v[idx]) for k, v in self.label]
-        if last_batch_handle == "discard":
-            new_n = self.num_data - self.num_data % batch_size
-            self.data = [(k, v[:new_n]) for k, v in self.data]
-            self.label = [(k, v[:new_n]) for k, v in self.label]
-            self.num_data = new_n
-        self.data_list = [v for _, v in self.data] + [v for _, v in self.label]
-        self.num_source = len(self.data_list)
-        self.cursor = -batch_size
+        for k, v in self.data + self.label:
+            if v.shape[0] != self.num_data:
+                raise MXNetError("source %s has %d rows, expected %d"
+                                 % (k, v.shape[0], self.num_data))
+        if self.num_data < batch_size:
+            raise MXNetError("batch_size needs to be smaller than data size.")
+        self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
+        self._rng = np.random
+        self._carry = np.array([], dtype=np.int64)  # roll_over tail
+        self._schedule = []
+        self._pos = 0
+        self._build_schedule()
+
+    # ------------------------------------------------------------- scheduling
+    def _build_schedule(self):
+        order = np.arange(self.num_data, dtype=np.int64)
+        if self.shuffle:
+            order = self._rng.permutation(self.num_data).astype(np.int64)
+        if self.last_batch_handle == "roll_over" and self._carry.size:
+            order = np.concatenate([self._carry, order])
+            self._carry = np.array([], dtype=np.int64)
+        b = self.batch_size
+        n_full = order.size // b
+        batches = [(order[i * b:(i + 1) * b], 0) for i in range(n_full)]
+        tail = order[n_full * b:]
+        if tail.size:
+            if self.last_batch_handle == "pad":
+                # wrap to the epoch start, report the wrapped count as pad
+                fill = order[:b - tail.size]
+                batches.append((np.concatenate([tail, fill]), b - tail.size))
+            elif self.last_batch_handle == "roll_over":
+                self._carry = tail  # becomes the head of the next epoch
+            # "discard": drop the tail
+        self._schedule = batches
+        self._pos = 0
 
     @property
     def provide_data(self):
-        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
-                         v.dtype)
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
                 for k, v in self.data]
 
     @property
     def provide_label(self):
-        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
-                         v.dtype)
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
                 for k, v in self.label]
 
     def hard_reset(self):
-        self.cursor = -self.batch_size
+        self._carry = np.array([], dtype=np.int64)
+        self._build_schedule()
 
     def reset(self):
-        if self.last_batch_handle == "roll_over" and \
-                self.cursor > self.num_data:
-            self.cursor = -self.batch_size + (self.cursor % self.num_data) \
-                % self.batch_size
-        else:
-            self.cursor = -self.batch_size
+        self._build_schedule()
 
     def iter_next(self):
-        self.cursor += self.batch_size
-        return self.cursor < self.num_data
+        if self._pos >= len(self._schedule):
+            return False
+        self._pos += 1
+        return True
 
-    def _getdata(self, data_source):
-        assert self.cursor < self.num_data, "DataIter needs reset."
-        if self.cursor + self.batch_size <= self.num_data:
-            return [nd.array(v[self.cursor:self.cursor + self.batch_size])
-                    for _, v in data_source]
-        pad = self.batch_size - self.num_data + self.cursor
-        return [nd.array(np.concatenate([v[self.cursor:], v[:pad]], axis=0))
-                for _, v in data_source]
+    def _current(self):
+        if not 0 < self._pos <= len(self._schedule):
+            raise MXNetError("DataIter needs reset.")
+        return self._schedule[self._pos - 1]
 
     def getdata(self):
-        return self._getdata(self.data)
+        idx, _ = self._current()
+        return [nd.array(v[idx]) for _, v in self.data]
 
     def getlabel(self):
-        return self._getdata(self.label)
+        idx, _ = self._current()
+        return [nd.array(v[idx]) for _, v in self.label]
 
     def getpad(self):
-        if self.last_batch_handle == "pad" and \
-                self.cursor + self.batch_size > self.num_data:
-            return self.cursor + self.batch_size - self.num_data
-        return 0
+        return self._current()[1]
 
 
 class MNISTIter(DataIter):
@@ -339,96 +359,146 @@ class ResizeIter(DataIter):
 
 
 class PrefetchingIter(DataIter):
-    """Double-buffering producer thread (parity: io.PrefetchingIter /
-    src/io/iter_prefetcher.h — the dmlc::ThreadedIter pattern in Python)."""
+    """Bounded-queue staging prefetcher.
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+    TPU-first design (capability parity with reference io.PrefetchingIter /
+    src/io/iter_prefetcher.h; mechanism is original): one producer thread per
+    child iterator feeds a bounded ``queue.Queue`` of depth ``prefetch_depth``.
+    The producer optionally *stages batches into device HBM* (``ctx=`` →
+    ``jax.device_put``) while the accelerator is busy with the previous step,
+    so the host→HBM copy overlaps compute — the role the reference fills with
+    a pinned-memory dmlc::ThreadedIter.  Epoch end is a sentinel in the queue,
+    so there is no event/flag handshake to get wrong.
+    """
+
+    _STOP = object()   # epoch-end sentinel
+
+    class _Raised(object):
+        """Producer-side exception forwarded through the queue."""
+
+        def __init__(self, exc):
+            self.exc = exc
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2, ctx=None):
         super().__init__()
-        if not isinstance(iters, list):
-            iters = [iters]
-        self.n_iter = len(iters)
-        assert self.n_iter > 0
-        self.iters = iters
+        self.iters = iters if isinstance(iters, list) else [iters]
+        assert self.iters, "need at least one child iterator"
         self.rename_data = rename_data
         self.rename_label = rename_label
+        self.prefetch_depth = max(1, prefetch_depth)
+        self._ctx = ctx
         self.batch_size = self.provide_data[0][1][0]
-        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
-        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
-        for e in self.data_taken:
-            e.set()
-        self.started = True
-        self.current_batch = [None for _ in range(self.n_iter)]
-        self.next_batch = [None for _ in range(self.n_iter)]
+        self.current_batch = None
+        self._queues = None
+        self._threads = []
+        self._alive = False
+        self._exhausted = False
+        self._start_epoch()
 
-        def prefetch_func(self, i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started:
-                    break
-                try:
-                    self.next_batch[i] = self.iters[i].next()
-                except StopIteration:
-                    self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
+    # ---------------------------------------------------------------- workers
+    def _stage(self, arrays):
+        """Move a list of NDArrays toward the device ahead of consumption."""
+        if self._ctx is None:
+            return arrays
+        return [a.copyto(self._ctx) if a.context != self._ctx else a
+                for a in arrays]
 
-        self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
-            for i in range(self.n_iter)]
-        for thread in self.prefetch_threads:
-            thread.start()
+    def _producer(self, child, q):
+        while True:
+            try:
+                b = child.next()
+                b.data = self._stage(b.data)
+                if b.label is not None:
+                    b.label = self._stage(b.label)
+            except StopIteration:
+                q.put(self._STOP)
+                return
+            except Exception as exc:  # forward to the consumer, don't vanish
+                q.put(self._Raised(exc))
+                return
+            q.put(b)
+            if not self._alive:
+                return
 
-    def __del__(self):
-        self.started = False
-        for e in self.data_taken:
-            e.set()
+    def _start_epoch(self):
+        import queue as _queue
+        self._drain()
+        self._alive = True
+        self._exhausted = False
+        self._queues = [_queue.Queue(maxsize=self.prefetch_depth)
+                        for _ in self.iters]
+        self._threads = [threading.Thread(target=self._producer, args=(c, q),
+                                          daemon=True)
+                         for c, q in zip(self.iters, self._queues)]
+        for t in self._threads:
+            t.start()
 
+    def _drain(self):
+        """Stop current producers and empty their queues."""
+        self._alive = False
+        if self._queues:
+            for q, t in zip(self._queues, self._threads):
+                while t.is_alive():
+                    try:
+                        q.get(timeout=0.01)
+                    except Exception:
+                        pass
+                t.join()
+        self._queues = None
+        self._threads = []
+
+    # -------------------------------------------------------------- protocol
     @property
     def provide_data(self):
-        if self.rename_data is None:
-            return sum([i.provide_data for i in self.iters], [])
-        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
-                     if isinstance(x, DataDesc) else DataDesc(*x)
-                     for x in i.provide_data]
-                    for r, i in zip(self.rename_data, self.iters)], [])
+        descs = []
+        for i, child in enumerate(self.iters):
+            ren = self.rename_data[i] if self.rename_data else {}
+            for x in child.provide_data:
+                d = x if isinstance(x, DataDesc) else DataDesc(*x)
+                descs.append(DataDesc(ren.get(d.name, d.name), d.shape,
+                                      d.dtype))
+        return descs
 
     @property
     def provide_label(self):
-        if self.rename_label is None:
-            return sum([i.provide_label for i in self.iters], [])
-        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
-                     if isinstance(x, DataDesc) else DataDesc(*x)
-                     for x in i.provide_label]
-                    for r, i in zip(self.rename_label, self.iters)], [])
+        descs = []
+        for i, child in enumerate(self.iters):
+            ren = self.rename_label[i] if self.rename_label else {}
+            for x in child.provide_label:
+                d = x if isinstance(x, DataDesc) else DataDesc(*x)
+                descs.append(DataDesc(ren.get(d.name, d.name), d.shape,
+                                      d.dtype))
+        return descs
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
-        for i in self.iters:
-            i.reset()
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        self._drain()  # stop producers before touching the children
+        for child in self.iters:
+            child.reset()
+        self._start_epoch()
 
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
-        if self.next_batch[0] is None:
-            for i in self.next_batch:
-                assert i is None, "Number of entry mismatches between iterators"
+        if self._exhausted:
             return False
-        for batch in self.next_batch:
-            assert batch.pad == self.next_batch[0].pad, \
-                "Number of entry mismatches between iterators"
+        parts = [q.get() for q in self._queues]
+        for p in parts:
+            if isinstance(p, self._Raised):
+                self._exhausted = True
+                raise p.exc
+        done = [p is self._STOP for p in parts]
+        if any(done):
+            self._exhausted = True
+            if not all(done):
+                raise MXNetError(
+                    "child iterators ended at different batch counts")
+            return False
+        pad0 = parts[0].pad
+        if any(p.pad != pad0 for p in parts):
+            raise MXNetError("child iterators disagree on pad")
         self.current_batch = DataBatch(
-            sum([batch.data for batch in self.next_batch], []),
-            sum([batch.label for batch in self.next_batch], []),
-            self.next_batch[0].pad, self.next_batch[0].index)
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+            sum([p.data for p in parts], []),
+            sum([p.label for p in parts], []),
+            pad0, parts[0].index)
         return True
 
     def next(self):
@@ -447,3 +517,9 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+    def __del__(self):
+        try:
+            self._drain()  # unblock producers stuck in q.put, release batches
+        except Exception:
+            pass

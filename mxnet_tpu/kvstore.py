@@ -89,10 +89,11 @@ class KVStore(object):
                     raise MXNetError("key %s not initialized" % str(k))
                 self._updater(k, merged, self._store[k])
             else:
-                if k in self._store:
-                    self._store[k] += merged
-                else:
-                    self._store[k] = merged.copy()
+                # No updater: the merged value REPLACES the stored value
+                # (parity: kvstore_local.h:70 `local = merged`) — the
+                # update_on_kvstore=False path pulls back the merged gradient,
+                # never weight + accumulated gradients.
+                self._store[k] = merged.copy()
 
     def pull(self, key, out=None, priority=0):
         """Pull current values into out array(s) (parity: kvstore.pull)."""

@@ -88,7 +88,7 @@ def _softmax_output_fn(grad_scale, ignore_label, multi_output, use_ignore,
           defaults={"grad_scale": 1.0, "ignore_label": -1.0,
                     "multi_output": False, "use_ignore": False,
                     "preserve_shape": False, "normalization": "null"},
-          infer_shape=_softmax_out_infer)
+          infer_shape=_softmax_out_infer, is_loss=True)
 def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
                     multi_output=False, use_ignore=False, preserve_shape=False,
                     normalization="null", out_grad=False, smooth_alpha=0.0):
@@ -136,7 +136,8 @@ def _reg_infer(attrs, in_shapes):
 def _make_regression(name, kind):
     @register(name, arg_names=("data", "label"),
               attr_types={"grad_scale": parse_float},
-              defaults={"grad_scale": 1.0}, infer_shape=_reg_infer)
+              defaults={"grad_scale": 1.0}, infer_shape=_reg_infer,
+              is_loss=True)
     def _fn(data, label, grad_scale=1.0, _kind=kind):
         return _regression_fn(_kind, grad_scale)(data, label)
     return _fn
@@ -173,7 +174,7 @@ def _make_loss_fn(grad_scale, valid_thresh, normalization):
           attr_types={"grad_scale": parse_float, "valid_thresh": parse_float,
                       "normalization": parse_str},
           defaults={"grad_scale": 1.0, "valid_thresh": 0.0,
-                    "normalization": "null"})
+                    "normalization": "null"}, is_loss=True)
 def _make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
     """Identity forward, constant grad_scale backward (parity: make_loss-inl.h)."""
     return _make_loss_fn(grad_scale, valid_thresh, normalization)(data)
@@ -215,7 +216,7 @@ def _svm_output_fn(margin, reg_coef, use_linear):
                     "use_linear": False},
           infer_shape=lambda attrs, ins: (
               [ins[0], None if ins[0] is None else (ins[0][0],)],
-              [ins[0]], None))
+              [ins[0]], None), is_loss=True)
 def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
                 use_linear=False):
     """(parity: svm_output-inl.h)"""

@@ -297,10 +297,21 @@ def _pooling(data, kernel=None, stride=(), pad=(), pool_type="max",
     if pool_type == "sum":
         return ssum
     if pool_type == "avg":
-        ones = jnp.ones(data.shape, data.dtype)
-        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
-                                    padding)
-        return ssum / cnt
+        # Divisor is the window extent clipped only to dim+pad, computed BEFORE
+        # clipping to the valid region (count_include_pad semantics, parity:
+        # pool.h:268 — pool_size = (hend-hstart)*(wend-wstart) pre-clip).
+        # Static shapes → compute per-axis divisors at trace time.
+        cnt = None
+        out_spatial = ssum.shape[2:]
+        for ax, (i_sz, k, s, p, o_sz) in enumerate(
+                zip(data.shape[2:], kernel, stride, pad, out_spatial)):
+            starts = _np.arange(o_sz) * s - p
+            ends = _np.minimum(starts + k, i_sz + p)
+            d = jnp.asarray((ends - starts).astype(_np.float32))
+            d = d.reshape((1, 1) + (1,) * ax + (o_sz,)
+                          + (1,) * (len(out_spatial) - ax - 1))
+            cnt = d if cnt is None else cnt * d
+        return (ssum / cnt).astype(data.dtype)
     raise MXNetError("unknown pool_type %s" % pool_type)
 
 

@@ -112,9 +112,10 @@ class OpDef(object):
     def __init__(self, name, fn, arg_names=("data",), aux_names=(), num_outputs=1,
                  attr_types=None, defaults=None, infer_shape=None, infer_type=None,
                  needs_rng=False, train_aware=False, key_var_num_args=None,
-                 aliases=(), hidden=False, doc=None):
+                 aliases=(), hidden=False, doc=None, is_loss=False):
         self.name = name
         self.fn = fn
+        self.is_loss = is_loss
         self._arg_names = arg_names
         self.aux_names = tuple(aux_names)
         self.num_aux = len(self.aux_names)

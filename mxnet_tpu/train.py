@@ -1,0 +1,339 @@
+"""Fused SPMD training step — the TPU-native execution core.
+
+The reference trains by dispatching per-op kernels through the threaded engine
+and synchronising gradients through a parameter server (push/pull:
+src/kvstore/kvstore_dist.h:28-318, device reduce: src/kvstore/comm.h:200-320,
+optimizer step: python/mxnet/optimizer.py).  On TPU the whole training step —
+forward, backward, optimizer update, AND the cross-device gradient reduction —
+is ONE jit-compiled XLA computation over a ``jax.sharding.Mesh``:
+
+- gradient pass:  ``jax.vjp`` over the lowered symbol graph (the reference's
+  nnvm Gradient pass, executed symbolically at trace time);
+- reduction:      batch inputs are sharded over the ``dp`` mesh axis and
+  parameters are replicated (or sharded over ``tp``); XLA inserts the
+  all-reduce over ICI automatically — no host transfers, no parameter server;
+- update:         the fused optimizer math from ops/optimizer_ops.py is inlined
+  into the same computation, so weights never leave HBM between steps;
+- memory:         parameter/optimizer/aux buffers are donated (the XLA-level
+  analogue of the reference's in-place kWriteInplace update), and optional
+  rematerialisation (``remat=True``) trades FLOPs for HBM — the TPU-native
+  ``MXNET_BACKWARD_DO_MIRROR`` (reference src/executor/graph_executor.cc:205-218).
+
+The Module/Executor layer remains the API-compatible surface; TrainStep is the
+performance path used by bench.py, examples, and the dist_tpu kvstore.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from . import ndarray as nd
+from . import random as _random
+
+__all__ = ["TrainStep", "EvalStep"]
+
+
+def _pspec(*names):
+    from jax.sharding import PartitionSpec
+    return PartitionSpec(*names)
+
+
+class _FunctionalOptimizer(object):
+    """Pure-function view of an Optimizer instance: (w, g, state, hyper) ->
+    (new_w, new_state).  Hyper-params that change across steps (lr, Adam bias
+    correction) arrive as traced scalars so XLA never recompiles on lr decay."""
+
+    def __init__(self, optimizer, param_names):
+        self.opt = optimizer
+        self.names = list(param_names)
+        # static per-param multipliers (parity: set_lr_mult/set_wd_mult;
+        # reference decays only *_weight / *_gamma by default)
+        self.lr_mult = {}
+        self.wd_mult = {}
+        for n in self.names:
+            self.lr_mult[n] = optimizer.lr_mult.get(n, 1.0)
+            default_wm = 1.0 if n.endswith(("_weight", "_gamma")) else 0.0
+            self.wd_mult[n] = optimizer.wd_mult.get(n, default_wm)
+        self.kind = type(optimizer).__name__.lower()
+        if self.kind not in ("sgd", "ccsgd", "nag", "adam", "rmsprop",
+                            "adagrad", "adadelta"):
+            raise MXNetError(
+                "TrainStep supports sgd/nag/adam/rmsprop/adagrad/adadelta; "
+                "got %s (use the Module path for others)" % self.kind)
+
+    # ------------------------------------------------------------------ state
+    def init_state(self, params):
+        import jax.numpy as jnp
+        zeros = lambda w: jnp.zeros(w.shape, w.dtype)
+        state = {}
+        for n, w in params.items():
+            if self.kind in ("sgd", "ccsgd", "nag"):
+                state[n] = (zeros(w),) if self.opt.momentum else ()
+            elif self.kind == "adam":
+                state[n] = (zeros(w), zeros(w))
+            elif self.kind == "rmsprop":
+                state[n] = (zeros(w),)
+            elif self.kind == "adagrad":
+                state[n] = (zeros(w),)
+            elif self.kind == "adadelta":
+                state[n] = (zeros(w), zeros(w))
+        return state
+
+    # ------------------------------------------------------------------ hyper
+    def hyper(self, num_update):
+        """Per-step traced scalars (host-computed, fed as jnp scalars)."""
+        o = self.opt
+        lr = o.lr
+        if getattr(o, "lr_scheduler", None) is not None:
+            lr = o.lr_scheduler(num_update)
+        h = {"lr": _np.float32(lr)}
+        if self.kind == "adam":
+            t = num_update + 1
+            coef1 = 1.0 - o.beta1 ** t
+            coef2 = 1.0 - o.beta2 ** t
+            h["lr"] = _np.float32(lr * (coef2 ** 0.5) / coef1)
+        return h
+
+    # ----------------------------------------------------------------- update
+    def update(self, name, w, g, state, hyper):
+        import jax.numpy as jnp
+        from .ops.registry import OPS
+        o = self.opt
+        lr = hyper["lr"] * self.lr_mult[name]
+        wd = o.wd * self.wd_mult[name]
+        clip = -1.0 if o.clip_gradient is None else o.clip_gradient
+        common = dict(lr=lr, wd=wd, rescale_grad=o.rescale_grad,
+                      clip_gradient=clip)
+        if self.kind in ("sgd", "ccsgd"):
+            if state:
+                nw, nm = OPS.get("sgd_mom_update").fn(
+                    w, g, state[0], momentum=o.momentum, **common)
+                return nw, (nm,)
+            return OPS.get("sgd_update").fn(w, g, **common), ()
+        if self.kind == "nag":
+            grad = g * o.rescale_grad
+            if o.clip_gradient is not None:
+                grad = jnp.clip(grad, -o.clip_gradient, o.clip_gradient)
+            if state:
+                mom = state[0] * o.momentum
+                grad = grad + wd * w
+                mom = mom + grad
+                grad = grad + o.momentum * mom
+                return w - lr * grad, (mom,)
+            return w - lr * (grad + wd * w), ()
+        if self.kind == "adam":
+            nw, nm, nv = OPS.get("adam_update").fn(
+                w, g, state[0], state[1], beta1=o.beta1, beta2=o.beta2,
+                epsilon=o.epsilon, **common)
+            return nw, (nm, nv)
+        if self.kind == "rmsprop":
+            nw, nn = OPS.get("rmsprop_update").fn(
+                w, g, state[0], gamma1=o.gamma1, epsilon=o.epsilon, **common)
+            return nw, (nn,)
+        if self.kind == "adagrad":
+            grad = g * o.rescale_grad
+            if o.clip_gradient is not None:
+                grad = jnp.clip(grad, -o.clip_gradient, o.clip_gradient)
+            hist = state[0] + jnp.square(grad)
+            return w - lr * (grad / jnp.sqrt(hist + o.float_stable_eps)
+                             + wd * w), (hist,)
+        if self.kind == "adadelta":
+            grad = g * o.rescale_grad
+            if o.clip_gradient is not None:
+                grad = jnp.clip(grad, -o.clip_gradient, o.clip_gradient)
+            acc_g = o.rho * state[0] + (1.0 - o.rho) * jnp.square(grad)
+            delta = (jnp.sqrt(state[1] + o.epsilon)
+                     / jnp.sqrt(acc_g + o.epsilon)) * grad
+            acc_d = o.rho * state[1] + (1.0 - o.rho) * jnp.square(delta)
+            return w - delta - wd * w, (acc_g, acc_d)
+        raise MXNetError("unreachable")
+
+
+class TrainStep(object):
+    """Compile a Symbol + Optimizer into one donated, sharded XLA train step.
+
+    Parameters
+    ----------
+    symbol : the loss-topped Symbol (e.g. SoftmaxOutput head)
+    optimizer : mxnet_tpu.optimizer.Optimizer instance
+    data_names / label_names : input variable names (not trained)
+    mesh : optional jax.sharding.Mesh with a 'dp' axis (and optionally 'tp');
+        None = single device
+    param_shardings : {param_name: PartitionSpec} for tensor-parallel params
+        (default: replicated)
+    remat : False | True | 'dots' — rematerialisation policy for the backward
+        pass (True = save nothing, 'dots' = save matmul outputs only)
+    dtype : compute dtype for the lowered graph; params stay float32, inputs
+        and the graph run in this dtype (bfloat16 recommended on TPU)
+    """
+
+    def __init__(self, symbol, optimizer, data_names=("data",),
+                 label_names=("softmax_label",), mesh=None,
+                 param_shardings=None, remat=False, dtype=None):
+        import jax
+        from .executor import _Lowered
+        self.symbol = symbol
+        self.mesh = mesh
+        self.param_shardings = dict(param_shardings or {})
+        self._low = _Lowered(symbol)
+        self.data_names = tuple(data_names)
+        self.label_names = tuple(label_names)
+        inputs = set(self.data_names) | set(self.label_names)
+        self.param_names = [n for n in self._low.arg_names if n not in inputs]
+        self.aux_names = list(self._low.aux_names)
+        self.fopt = _FunctionalOptimizer(optimizer, self.param_names)
+        self.optimizer = optimizer
+        self.num_update = 0
+        self._dtype = dtype
+        low = self._low
+
+        def fwd(params, aux, batch, rng):
+            vals = dict(batch)
+            if dtype is not None:
+                vals = {k: v.astype(dtype) if v.dtype == _np.float32 else v
+                        for k, v in vals.items()}
+                params = {k: v.astype(dtype) for k, v in params.items()}
+            vals.update(params)
+            outs, aux_upd = low.run(vals, aux, rng, True)
+            return tuple(outs), aux_upd
+
+        if remat:
+            policy = None
+            if remat == "dots":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            fwd = jax.checkpoint(fwd, policy=policy)
+
+        def step(params, opt_state, aux, batch, rng, hyper):
+            import jax.numpy as jnp
+
+            def f(p):
+                return fwd(p, aux, batch, rng)
+            outs, vjp_fn, aux_upd = jax.vjp(f, params, has_aux=True)
+            ones = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+            grads = vjp_fn(ones)[0]
+            new_params, new_state = {}, {}
+            for n in self.param_names:
+                g = grads[n].astype(params[n].dtype)
+                new_params[n], new_state[n] = self.fopt.update(
+                    n, params[n], g, opt_state[n], hyper)
+            new_aux = dict(aux)
+            new_aux.update({k: v.astype(aux[k].dtype)
+                            for k, v in aux_upd.items() if k in aux})
+            return new_params, new_state, new_aux, outs
+
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            ps = dict(param_shardings or {})
+            rep = NamedSharding(mesh, _pspec())
+
+            def par_shard(n):
+                return NamedSharding(mesh, ps[n]) if n in ps else rep
+            param_sh = {n: par_shard(n) for n in self.param_names}
+            batch_sh = {n: NamedSharding(mesh, _pspec("dp"))
+                        for n in inputs}
+            self._step = jax.jit(
+                step,
+                in_shardings=(param_sh, None, None, batch_sh, rep, None),
+                donate_argnums=(0, 1, 2))
+        else:
+            self._step = jax.jit(step, donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------- init
+    def init(self, data_shapes, label_shapes=None, initializer=None, seed=0):
+        """Infer shapes, initialise params/aux with `initializer`, build
+        optimizer state.  Returns (params, opt_state, aux) pytrees of
+        jax.Arrays, placed according to the mesh."""
+        import jax
+        import jax.numpy as jnp
+        from . import initializer as init_mod
+        if initializer is None:
+            initializer = init_mod.Xavier(magnitude=2.0)
+        shapes = dict(data_shapes)
+        if label_shapes:
+            shapes.update(label_shapes)
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shapes)
+        if arg_shapes is None:
+            raise MXNetError("TrainStep.init: shape inference incomplete")
+        name2shape = dict(zip(self._low.arg_names, arg_shapes))
+        aux2shape = dict(zip(self.aux_names, aux_shapes))
+        _random.seed(seed)
+        params = {}
+        for n in self.param_names:
+            arr = nd.zeros(name2shape[n])
+            initializer(init_mod.InitDesc(n), arr)
+            params[n] = arr.value
+        aux = {}
+        for n in self.aux_names:
+            v = jnp.ones(aux2shape[n], _np.float32) \
+                if ("moving_var" in n or "_var" in n) \
+                else jnp.zeros(aux2shape[n], _np.float32)
+            aux[n] = v
+        opt_state = self.fopt.init_state(params)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+            rep = NamedSharding(self.mesh, _pspec())
+
+            def shard_of(n):
+                if n in self.param_shardings:
+                    return NamedSharding(self.mesh, self.param_shardings[n])
+                return rep
+            params = {n: jax.device_put(v, shard_of(n))
+                      for n, v in params.items()}
+            # optimizer state tensors follow their parameter's sharding
+            opt_state = {n: tuple(jax.device_put(s, shard_of(n)) for s in st)
+                         for n, st in opt_state.items()}
+            aux = jax.device_put(aux, rep)
+        return params, opt_state, aux
+
+    def shard_batch(self, batch):
+        """Place a host batch dict on the mesh, sharded along 'dp' (axis 0)."""
+        import jax
+        if self.mesh is None:
+            return {k: jax.numpy.asarray(v) for k, v in batch.items()}
+        from jax.sharding import NamedSharding
+        sh = NamedSharding(self.mesh, _pspec("dp"))
+        return {k: jax.device_put(v, sh) for k, v in batch.items()}
+
+    # ------------------------------------------------------------------- call
+    def __call__(self, params, opt_state, aux, batch, rng=None):
+        """One fused step.  Returns (params, opt_state, aux, outputs)."""
+        if rng is None:
+            rng = _random.next_key()
+        hyper = self.fopt.hyper(self.num_update)
+        self.num_update += 1
+        return self._step(params, opt_state, aux, batch, rng, hyper)
+
+
+class EvalStep(object):
+    """Jitted forward-only step (inference path; parity: the predict API's
+    forward-only executor, reference src/c_api/c_predict_api.cc)."""
+
+    def __init__(self, symbol, mesh=None, dtype=None):
+        import jax
+        from .executor import _Lowered
+        low = _Lowered(symbol)
+        self._low = low
+        self.mesh = mesh
+
+        def fwd(params, aux, batch, rng):
+            vals = dict(batch)
+            if dtype is not None:
+                vals = {k: v.astype(dtype) if v.dtype == _np.float32 else v
+                        for k, v in vals.items()}
+                params = {k: v.astype(dtype) for k, v in params.items()}
+            vals.update(params)
+            outs, _ = low.run(vals, aux, rng, False)
+            return tuple(outs)
+
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            rep = NamedSharding(mesh, _pspec())
+            data_sh = NamedSharding(mesh, _pspec("dp"))
+            self._fwd = jax.jit(fwd, in_shardings=(None, None, data_sh, rep))
+        else:
+            self._fwd = jax.jit(fwd)
+
+    def __call__(self, params, aux, batch, rng=None):
+        if rng is None:
+            rng = _random.next_key()
+        return self._fwd(params, aux, batch, rng)

@@ -1,9 +1,19 @@
 """Test harness: run everything on an 8-device virtual CPU mesh so multi-chip
 sharding semantics are exercised without TPU hardware (the driver's
-dryrun_multichip uses the same mechanism)."""
+dryrun_multichip uses the same mechanism).
+
+Note: env vars alone are not enough — the site's PJRT plugin registration can
+pin the platform before user code runs, so we also override programmatically
+after importing jax (before any backend is initialised).
+"""
 import os
 
-os.environ.setdefault("XLA_FLAGS",
-                      (os.environ.get("XLA_FLAGS", "") +
-                       " --xla_force_host_platform_device_count=8").strip())
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
