@@ -27,6 +27,7 @@ from . import metric
 from . import lr_scheduler
 from . import callback
 from . import kvstore
+from . import kvstore as kv
 from . import model
 from . import module
 from . import parallel

@@ -77,7 +77,12 @@ class Context(object):
         }[self.device_type]
         for plat in plat_order:
             try:
-                devs = jax.devices(plat) if plat else jax.devices()
+                # local_devices, not devices: under multi-process distributed
+                # training each process may only place data on its own
+                # addressable devices (global devices are reachable solely
+                # through collectives over the mesh).
+                devs = (jax.local_devices(backend=plat) if plat
+                        else jax.local_devices())
                 if plat is None and devs and devs[0].platform == "cpu" \
                         and self.device_type in ("gpu", "tpu"):
                     # default backend is host: treat virtual host devices as chips

@@ -77,13 +77,19 @@ class KVStore(object):
         (parity: kvstore.push → KVStoreLocal::Push / KVStoreDist::Push)."""
         keys, single = _key_list(key)
         values = _value_list(value, len(keys), single)
+        merged_by_key = {}
         for k, vlist in zip(keys, values):
             if not isinstance(vlist, list):
                 vlist = [vlist]
-            merged = _reduce(vlist)
-            if self.type.startswith("dist"):
-                from .parallel import dist as _dist
-                merged = _dist.allreduce(merged)
+            merged_by_key[k] = _reduce(vlist)
+        if self.type.startswith("dist"):
+            # all keys of this push cross the workers in ONE fused XLA
+            # all-reduce (parity: the reference batches per-key ZPush engine
+            # ops; here the batching is a single compiled collective)
+            from .parallel import dist as _dist
+            merged_by_key = _dist.allreduce_tree(merged_by_key)
+        for k in keys:
+            merged = merged_by_key[k]
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError("key %s not initialized" % str(k))

@@ -8,14 +8,26 @@ Environment contract (replaces DMLC_ROLE/DMLC_PS_ROOT_URI):
 A single process with no env vars set runs standalone (rank 0 of 1) — the same
 code path the reference's `local` tracker exercises.
 
-Worker-death detection (parity: KVStore::get_num_dead_node via ps heartbeats) is
-delegated to the JAX coordination service: a missing host fails the collective,
-and recovery is checkpoint-resume (SURVEY.md §5.3 notes the PS hot-state model
-is intentionally replaced by checkpointing).
+Collective design (TPU-native replacement for KVStoreDist::Push/Pull,
+reference src/kvstore/kvstore_dist.h:28-318): instead of copying gradients to
+pinned host buffers and shipping them to parameter-server processes over ZMQ,
+each worker contributes its already-on-device gradient as one shard of a
+global jax.Array laid out along a ``worker`` mesh axis; a jitted ``sum`` over
+that axis is compiled by XLA into an all-reduce that rides ICI (single slice)
+or DCN (multi-slice).  No per-step host transfer, no server processes.  All
+keys pushed in one step are reduced in ONE fused XLA computation
+(``allreduce_tree``) — the analogue of the reference's per-key ZPush batching.
+
+Worker-death detection (parity: KVStore::get_num_dead_node via ps heartbeats)
+is delegated to the JAX coordination service: a missing host fails the
+collective, and recovery is checkpoint-resume (SURVEY.md §5.3 notes the PS
+hot-state model is intentionally replaced by checkpointing).
 """
 from __future__ import annotations
 
 import os
+
+import numpy as _np
 
 from ..base import get_env
 
@@ -32,6 +44,17 @@ def init_process_group():
     pid = get_env("MXTPU_PROCESS_ID", typ=int)
     if coord and nproc and nproc > 1:
         import jax
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            # The env var alone can be ignored when an accelerator plugin is
+            # installed; pin the platform programmatically (must precede any
+            # backend-initialising call).  The CPU backend also needs an
+            # explicit cross-process collectives implementation (TPU rides
+            # ICI natively).
+            try:
+                jax.config.update("jax_platforms", "cpu")
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception:
+                pass
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nproc, process_id=pid or 0)
     _initialized = True
@@ -58,14 +81,99 @@ def barrier(name="kvstore"):
         multihost_utils.sync_global_devices(name)
 
 
-def allreduce(value):
-    """Sum an NDArray across worker processes (psum over the global mesh;
-    parity: the dist kvstore server-side merge)."""
+# --------------------------------------------------------------------------
+# On-device cross-process allreduce
+# --------------------------------------------------------------------------
+_worker_mesh = None
+_sum_cache = {}
+
+
+def worker_mesh():
+    """1-D mesh with one leader device per process (axis name ``worker``).
+
+    The global array built over this mesh has one shard per worker; summing
+    its leading axis is the cross-worker gradient reduction, and XLA lowers
+    it to an all-reduce collective between the leader devices.
+    """
+    global _worker_mesh
+    if _worker_mesh is None:
+        import jax
+        from jax.sharding import Mesh
+        leaders = {}
+        for d in jax.devices():
+            leaders.setdefault(d.process_index, d)
+        devs = [leaders[p] for p in sorted(leaders)]
+        _worker_mesh = Mesh(_np.asarray(devs), ("worker",))
+    return _worker_mesh
+
+
+def _sum_fn(nshapes_key):
+    """Jitted per-pytree sum over the worker axis, replicated output."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    fn = _sum_cache.get(nshapes_key)
+    if fn is None:
+        mesh = worker_mesh()
+        rep = NamedSharding(mesh, PartitionSpec())
+
+        def reduce_all(stacked):
+            return [x.sum(axis=0) for x in stacked]
+
+        fn = jax.jit(reduce_all, out_shardings=rep)
+        _sum_cache[nshapes_key] = fn
+    return fn
+
+
+def _to_global(x):
+    """Wrap this process's array as its shard of a (W, *shape) global array."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    mesh = worker_mesh()
+    my_leader = jax.local_devices()[0]
+    local = jax.device_put(_np.asarray(x)[None]
+                           if isinstance(x, _np.ndarray) else x[None],
+                           my_leader)
+    W = mesh.devices.size
+    spec = PartitionSpec("worker", *([None] * (local.ndim - 1)))
+    return jax.make_array_from_single_device_arrays(
+        (W,) + tuple(local.shape[1:]), NamedSharding(mesh, spec), [local])
+
+
+def allreduce_arrays(arrays):
+    """Sum a list of jax arrays across worker processes in ONE fused XLA
+    computation (the dist kvstore's merge; no host round-trip)."""
     init_process_group()
     import jax
     if jax.process_count() <= 1:
+        return list(arrays)
+    stacked = [_to_global(a) for a in arrays]
+    key = tuple((tuple(a.shape), str(a.dtype)) for a in stacked)
+    outs = _sum_fn(key)(stacked)
+    # outputs are replicated over the worker mesh; hand back this process's
+    # shard so results compose with process-local arrays (stays on device)
+    return [o.addressable_shards[0].data for o in outs]
+
+
+def allreduce(value):
+    """Sum one NDArray across worker processes (XLA all-reduce over the
+    worker mesh; parity: the dist kvstore server-side merge)."""
+    import jax
+    init_process_group()
+    if jax.process_count() <= 1:
         return value
-    from jax.experimental import multihost_utils
     from .. import ndarray as nd
-    summed = multihost_utils.process_allgather(value.value)
-    return nd.NDArray(summed.sum(axis=0), ctx=value.context)
+    out = allreduce_arrays([value.value])[0]
+    return nd.NDArray(out, ctx=value.context)
+
+
+def allreduce_tree(values):
+    """Sum a dict {key: NDArray} across workers in one fused computation."""
+    import jax
+    init_process_group()
+    if jax.process_count() <= 1:
+        return dict(values)
+    from .. import ndarray as nd
+    keys = sorted(values)
+    outs = allreduce_arrays([values[k].value for k in keys])
+    return {k: nd.NDArray(o, ctx=values[k].context)
+            for k, o in zip(keys, outs)}

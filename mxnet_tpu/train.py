@@ -190,7 +190,12 @@ class TrainStep(object):
         def fwd(params, aux, batch, rng):
             vals = dict(batch)
             if dtype is not None:
-                vals = {k: v.astype(dtype) if v.dtype == _np.float32 else v
+                # cast only the data inputs — labels carry class ids that
+                # bfloat16 would round (997 -> 996), silently corrupting the
+                # one-hot targets
+                vals = {k: (v.astype(dtype)
+                            if k not in self.label_names
+                            and v.dtype == _np.float32 else v)
                         for k, v in vals.items()}
                 params = {k: v.astype(dtype) for k, v in params.items()}
             vals.update(params)
@@ -308,17 +313,21 @@ class EvalStep(object):
     """Jitted forward-only step (inference path; parity: the predict API's
     forward-only executor, reference src/c_api/c_predict_api.cc)."""
 
-    def __init__(self, symbol, mesh=None, dtype=None):
+    def __init__(self, symbol, mesh=None, dtype=None,
+                 label_names=("softmax_label",)):
         import jax
         from .executor import _Lowered
         low = _Lowered(symbol)
         self._low = low
         self.mesh = mesh
+        label_names = tuple(label_names)
 
         def fwd(params, aux, batch, rng):
             vals = dict(batch)
             if dtype is not None:
-                vals = {k: v.astype(dtype) if v.dtype == _np.float32 else v
+                # labels keep their dtype (bfloat16 rounds class ids)
+                vals = {k: (v.astype(dtype) if k not in label_names
+                            and v.dtype == _np.float32 else v)
                         for k, v in vals.items()}
                 params = {k: v.astype(dtype) for k, v in params.items()}
             vals.update(params)

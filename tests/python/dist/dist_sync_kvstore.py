@@ -1,0 +1,74 @@
+"""Multi-process dist kvstore arithmetic test (parity: reference
+tests/nightly/dist_sync_kvstore.py:14-46).
+
+Run via the launcher:
+    JAX_PLATFORMS=cpu python tools/launch.py -n 2 \
+        python tests/python/dist/dist_sync_kvstore.py
+
+Each worker pushes rank-dependent gradients; the store-side Test optimizer
+(w += rate * merged_grad) makes the expected value exactly computable:
+after `nrepeat` pushes, value == (nworker+1)*nworker/2 * rate * nrepeat + 1.
+The merge itself is an XLA all-reduce over the worker mesh — no parameter
+server, no host-side gather (mxnet_tpu/parallel/dist.py).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+from mxnet_tpu.parallel import dist
+
+dist.init_process_group()  # before any backend-initialising call
+
+import numpy as np  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+
+keys = [3, 5, 7]
+rate = 2
+shape = (2, 2)
+big_shape = (1200, 1200)  # larger than the reference's BIGARRAY_BOUND
+
+
+def check_diff_to_scalar(arr, x):
+    assert np.sum(np.abs(arr.asnumpy() - x)) == 0, (arr.asnumpy(), x)
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    kv.init(keys, [mx.nd.ones(shape)] * len(keys))
+    kv.init(99, mx.nd.ones(big_shape))
+    kv.set_optimizer(mx.optimizer.create("test", rate))
+
+    my_rank = kv.rank
+    nworker = kv.num_workers
+    assert nworker == int(os.environ["MXTPU_NUM_PROCESSES"])
+
+    nrepeat = 3
+    for _ in range(nrepeat):
+        kv.push(3, mx.nd.ones(shape) * (my_rank + 1))
+        kv.push(99, mx.nd.ones(big_shape) * (my_rank + 1))
+
+    num = (nworker + 1) * nworker * rate / 2 * nrepeat + 1
+    val = mx.nd.zeros(shape)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, num)
+
+    val2 = mx.nd.zeros(big_shape)
+    kv.pull(99, out=val2)
+    check_diff_to_scalar(val2, num)
+
+    # no-updater path: pull returns the merged gradient (replace semantics)
+    kv2 = mx.kv.KVStore("dist_sync")
+    kv2.init(11, mx.nd.ones(shape))
+    kv2.push(11, mx.nd.ones(shape) * (my_rank + 2))
+    val3 = mx.nd.zeros(shape)
+    kv2.pull(11, out=val3)
+    expect = sum(r + 2 for r in range(nworker))
+    check_diff_to_scalar(val3, expect)
+
+    kv.barrier()
+    print("dist_sync_kvstore rank %d/%d OK" % (my_rank, nworker))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Multi-process distributed tests: run the dist_sync_kvstore arithmetic
+script as 2 real processes on this host via tools/launch.py (parity with the
+reference's `launch.py -n 3 --launcher local dist_sync_kvstore.py` nightly).
+
+The child processes use the CPU backend with gloo cross-process collectives;
+the kvstore merge is a jitted XLA all-reduce over the 2-process worker mesh —
+the same code path dist_tpu uses over ICI on a pod.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", "..", ".."))
+
+
+@pytest.mark.timeout(300)
+def test_dist_sync_kvstore_two_processes():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # children build their own 2-process world; drop any outer test-mesh flags
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"), "-n", "2",
+         sys.executable,
+         os.path.join(ROOT, "tests", "python", "dist",
+                      "dist_sync_kvstore.py")],
+        env=env, cwd=ROOT, capture_output=True, text=True, timeout=280)
+    ok = proc.stdout.count("OK")
+    assert proc.returncode == 0 and ok == 2, (
+        "rc=%d\nstdout:\n%s\nstderr:\n%s"
+        % (proc.returncode, proc.stdout[-2000:], proc.stderr[-4000:]))

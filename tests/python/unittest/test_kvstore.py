@@ -1,0 +1,125 @@
+"""KVStore aggregation arithmetic (parity: reference
+tests/python/unittest/test_kvstore.py — exact math vs numpy, incl. the
+update_on_kvstore=False replace semantics of kvstore_local.h:70)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def init_kv():
+    kv = mx.kv.create()
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.init(KEYS, [mx.nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def check_diff_to_scalar(arr, x):
+    assert np.sum(np.abs(arr.asnumpy() - x)) == 0
+
+
+def test_init_pull():
+    kv = mx.kv.create()
+    kv.init(3, mx.nd.ones(SHAPE) * 4)
+    a = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=a)
+    check_diff_to_scalar(a, 4)
+
+
+def test_single_kv_pair():
+    kv = init_kv()
+    kv.push(3, mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 1)
+
+
+def test_list_kv_pair():
+    kv = init_kv()
+    kv.push(KEYS, [mx.nd.ones(SHAPE) * 4] * len(KEYS))
+    val = [mx.nd.empty(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=val)
+    for v in val:
+        check_diff_to_scalar(v, 4)
+
+
+def test_aggregator():
+    kv = init_kv()
+    num_devs = 4
+    devs = [mx.Context("cpu", i) for i in range(num_devs)]
+
+    vals = [mx.nd.ones(SHAPE, d) for d in devs]
+    kv.push(3, vals)
+    kv.pull(3, out=vals)
+    for v in vals:
+        check_diff_to_scalar(v, num_devs)
+
+    vals = [[mx.nd.ones(SHAPE, d) * 2.0 for d in devs]
+            for _ in KEYS]
+    kv.push(KEYS, vals)
+    kv.pull(KEYS, out=vals)
+    for vv in vals:
+        for v in vv:
+            check_diff_to_scalar(v, num_devs * 2.0)
+
+
+def test_updater():
+    kv = init_kv()
+    kv.set_updater(lambda key, recv, local: local.__iadd__(recv))
+    num_devs = 4
+    devs = [mx.Context("cpu", i) for i in range(num_devs)]
+
+    vals = [mx.nd.ones(SHAPE, d) for d in devs]
+    kv.push(3, vals)
+    kv.pull(3, out=vals)
+    for v in vals:
+        check_diff_to_scalar(v, num_devs)
+
+    num_push = 4
+    vals = [[mx.nd.ones(SHAPE, d) for d in devs] for _ in KEYS]
+    for _ in range(num_push):
+        kv.push(KEYS, vals)
+    out = [mx.nd.empty(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=out)
+    for v in out:
+        check_diff_to_scalar(v, num_devs * num_push)
+
+
+def test_no_updater_replaces():
+    """push without an updater REPLACES the stored value with the merged
+    gradient (kvstore_local.h:70): init ones, push ones -> pull 1, not 2,
+    and a second push does not accumulate."""
+    kv = mx.kv.create()
+    kv.init(3, mx.nd.ones(SHAPE))
+    kv.push(3, mx.nd.ones(SHAPE) * 4)
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 4)
+    kv.push(3, mx.nd.ones(SHAPE) * 2)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 2)
+
+
+def test_get_type_rank():
+    kv = mx.kv.create("local")
+    assert kv.type == "local"
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+
+
+def test_test_optimizer_store_side():
+    """store-side optimizer (update_on_kvstore): w += rate * merged."""
+    kv = init_kv()
+    kv.set_optimizer(mx.optimizer.create("test", 2.0))
+    kv.push(3, [mx.nd.ones(SHAPE), mx.nd.ones(SHAPE)])
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 4)  # 0 + 2*(1+1)
+
+
+def test_unknown_type_raises():
+    with pytest.raises(Exception):
+        mx.kv.create("nope")

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Distributed job launcher (parity: reference tools/launch.py + the dmlc
+tracker's `local` launcher — SURVEY.md §2.6).
+
+The reference spawns a ZMQ parameter-server scheduler plus N server and N
+worker processes wired together through DMLC_* env vars.  The TPU-native
+runtime has no server processes: every process is a worker participating in
+XLA collectives, coordinated by the JAX coordination service at process 0.
+This launcher therefore only has to start N identical processes with the
+MXTPU_* env contract (see mxnet_tpu/parallel/dist.py):
+
+    python tools/launch.py -n 4 python train.py ...
+
+Launch modes:
+- ``local`` (default): N processes on this host — the mode the reference's
+  nightly dist tests use; on a TPU pod each host runs one process and an
+  external scheduler (GKE/SLURM/ray) plays this role instead.
+- ``ssh``: one process per host listed in --hostfile, sharing the same env
+  contract (requires passwordless ssh; mirrors the reference's ssh tracker).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(n, command, env_extra=None):
+    """Run n copies of `command` locally with the MXTPU_* env contract.
+    Returns the first non-zero exit code (0 if all succeed)."""
+    port = _free_port()
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env["MXTPU_COORDINATOR"] = "localhost:%d" % port
+        env["MXTPU_NUM_PROCESSES"] = str(n)
+        env["MXTPU_PROCESS_ID"] = str(rank)
+        procs.append(subprocess.Popen(command, env=env))
+    rc = 0
+    try:
+        for p in procs:
+            prc = p.wait()
+            rc = rc or prc
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGINT)
+        rc = 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return rc
+
+
+def launch_ssh(hosts, command, env_extra=None):
+    """One process per host over ssh; process 0's host is the coordinator."""
+    port = _free_port()
+    coord = "%s:%d" % (hosts[0], port)
+    procs = []
+    for rank, host in enumerate(hosts):
+        env = {"MXTPU_COORDINATOR": coord,
+               "MXTPU_NUM_PROCESSES": str(len(hosts)),
+               "MXTPU_PROCESS_ID": str(rank)}
+        env.update(env_extra or {})
+        env_str = " ".join("%s=%s" % kv for kv in env.items())
+        procs.append(subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", host,
+             "cd %s && env %s %s" % (os.getcwd(), env_str,
+                                     " ".join(command))]))
+    rc = 0
+    for p in procs:
+        rc = rc or p.wait()
+    return rc
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--launcher", choices=("local", "ssh"), default="local")
+    ap.add_argument("--hostfile", default=None,
+                    help="file with one host per line (ssh launcher)")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    if args.launcher == "local":
+        rc = launch_local(args.num_workers, args.command)
+    else:
+        with open(args.hostfile) as f:
+            hosts = [h.strip() for h in f if h.strip()]
+        rc = launch_ssh(hosts[:args.num_workers], args.command)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
