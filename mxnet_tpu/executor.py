@@ -15,8 +15,11 @@ TPU-first replacement for the GraphExecutor pipeline (SURVEY.md §2.4):
                                            parallelism without SPMD; the sharded
                                            path lives in mxnet_tpu.parallel)
 
-Training calls the *fused* forward+backward computation so XLA sees the whole step
-and shares subexpressions (no double forward).
+Training lowers through jax.vjp over the jitted graph: the forward executes
+once (saving residuals — the reference's per-op workspaces), and backward runs
+only the compiled pullback, for implicit or explicit head gradients alike.
+The single-program fused step (forward+backward+update in one XLA computation)
+is the TrainStep path in mxnet_tpu/train.py.
 """
 from __future__ import annotations
 
@@ -53,11 +56,14 @@ class _Lowered(object):
         self.aux_names = symbol.list_auxiliary_states()
         self.out_keys = [(id(n), i) for n, i in symbol._outputs]
 
-    def run(self, arg_vals, aux_vals, rng, is_train):
-        """Trace the graph: dict name->array in, (outputs, aux_updates) out."""
+    def run(self, arg_vals, aux_vals, rng, is_train, collect=False):
+        """Trace the graph: dict name->array in, (outputs, aux_updates) out.
+        With collect=True also returns {internal_name: value} for every op
+        output — the monitor's data, gathered from the ONE real execution."""
         import jax
         values = {}
         aux_updates = {}
+        collected = {}
         for node in self.order:
             if node.is_var:
                 if node.name in arg_vals:
@@ -79,6 +85,10 @@ class _Lowered(object):
             n_vis = node.op.num_outputs_for(node.params)
             for i in range(n_vis):
                 values[(id(node), i)] = out[i]
+                if collect:
+                    nm = node.name + ("_output" if n_vis == 1
+                                      else "_output%d" % i)
+                    collected[nm] = out[i]
             if node.op.num_aux:
                 names = node.op.arg_names_for(node.params)
                 aux_pos = [i for i, nm in enumerate(names)
@@ -88,6 +98,8 @@ class _Lowered(object):
                     if child.is_var and is_train:
                         aux_updates[child.name] = out[n_vis + k]
         outputs = [values[k] for k in self.out_keys]
+        if collect:
+            return outputs, aux_updates, collected
         return outputs, aux_updates
 
 
@@ -137,8 +149,7 @@ class Executor(object):
                          dtype=t if t is not None else _np.float32))
         self._jit_cache = {}
         self._monitor_cb = None
-        self._cached_grads = None
-        self._last_rng = None
+        self._pullback = None
         self._warned_default_heads = False
         self._multi_device = self._detect_multi_device()
 
@@ -255,32 +266,34 @@ class Executor(object):
                 if self.grad_req.get(n, "null") != "null" and n in self.grad_dict]
 
     def _get_jit(self, kind):
-        """kind: 'fwd_test' | 'fwd_train' | 'fused' | 'bwd'."""
+        """kind: 'fwd_test' | 'fwd_train' (+ '_mon' suffix = monitor collect);
+        'grad' | 'grad_mon' = the differentiated forward used under jax.vjp."""
         import jax
         fn = self._jit_cache.get(kind)
         if fn is not None:
             return fn
         low = self._low
-        grad_names = tuple(self._grad_arg_names())
+        collect = kind.endswith("_mon")
 
-        if kind in ("fwd_test", "fwd_train"):
-            is_train = kind == "fwd_train"
+        if kind.startswith("fwd"):
+            is_train = kind.startswith("fwd_train")
 
             def fwd(args, aux, rng):
-                outs, aux_upd = low.run(args, aux, rng, is_train)
-                return outs, aux_upd
+                return low.run(args, aux, rng, is_train, collect=collect)
             fn = jax.jit(fwd)
         else:
-            def fused(gargs, oargs, aux, rng, out_grads):
-                def f(ga):
-                    all_args = dict(oargs)
-                    all_args.update(ga)
-                    outs, aux_upd = low.run(all_args, aux, rng, True)
-                    return tuple(outs), aux_upd
-                outs, vjp_fn, aux_upd = jax.vjp(f, gargs, has_aux=True)
-                grads = vjp_fn(tuple(out_grads))[0]
-                return list(outs), aux_upd, grads
-            fn = jax.jit(fused)
+            # Differentiated forward: jax.vjp over this jitted function runs
+            # the forward ONCE (with residuals saved) and hands back a
+            # compiled pullback — backward never re-executes the forward,
+            # matching the reference's stored-workspace semantics.
+            def f(gargs, oargs, aux, rng):
+                all_args = dict(oargs)
+                all_args.update(gargs)
+                res = low.run(all_args, aux, rng, True, collect=collect)
+                outs, aux_upd = res[0], res[1]
+                coll = res[2] if collect else {}
+                return tuple(outs), (aux_upd, coll)
+            fn = jax.jit(f)
         self._jit_cache[kind] = fn
         return fn
 
@@ -325,61 +338,61 @@ class Executor(object):
                 self.arg_dict[k]._set_value(v.value)
             else:
                 self.arg_dict[k][:] = v
+        import jax
         rng = _random.next_key()
-        self._last_rng = rng
-        self._cached_grads = None
+        self._pullback = None
+        monitor = self._monitor_cb is not None
+        collected = {}
         if self._multi_device:
-            outs, aux_upd = self._forward_eager(is_train, rng)
+            outs, aux_upd = self._forward_eager(is_train, rng,
+                                                monitor=monitor)
         elif is_train and self._grad_arg_names():
             gnames = self._grad_arg_names()
             argv = self._arg_values()
             gargs = {n: argv[n] for n in gnames}
             oargs = {n: v for n, v in argv.items() if n not in gargs}
-            out_grads = [_ones_like_val(o) for o in self._output_nds]
-            fn = self._get_jit("fused")
-            outs, aux_upd, grads = fn(gargs, oargs, self._aux_values(), rng,
-                                      out_grads)
-            self._cached_grads = grads
+            fn = self._get_jit("grad_mon" if monitor else "grad")
+            aux_vals = self._aux_values()
+            outs, pullback, (aux_upd, collected) = jax.vjp(
+                lambda ga: fn(ga, oargs, aux_vals, rng), gargs, has_aux=True)
+            self._pullback = pullback
         else:
-            fn = self._get_jit("fwd_train" if is_train else "fwd_test")
-            outs, aux_upd = fn(self._arg_values(), self._aux_values(), rng)
+            fn = self._get_jit(("fwd_train" if is_train else "fwd_test")
+                               + ("_mon" if monitor else ""))
+            res = fn(self._arg_values(), self._aux_values(), rng)
+            outs, aux_upd = res[0], res[1]
+            if monitor:
+                collected = res[2]
         for ndarr, v in zip(self._output_nds, outs):
             ndarr._set_value(v)
         if is_train:
             for name, v in aux_upd.items():
                 if name in self.aux_dict:
                     self.aux_dict[name]._set_value(v)
-        if self._monitor_cb is not None:
-            self._run_monitor(is_train, rng)
+        for name, val in collected.items():
+            self._monitor_cb(name, NDArray(val))
         return self._output_nds
 
     def backward(self, out_grads=None):
         """Accumulate gradients into bound grad arrays (parity:
-        Executor::Backward; grad_req write/add semantics)."""
+        Executor::Backward; grad_req write/add semantics).  Runs only the
+        pullback of the last forward(is_train=True) — the forward is never
+        re-executed, and stochastic ops (Dropout) reuse the masks saved in
+        the forward's residuals, whether out_grads is implicit or explicit."""
         gnames = self._grad_arg_names()
         if not gnames:
             return
         if out_grads is None:
             self._check_default_heads()
-        if out_grads is None and self._cached_grads is not None:
-            grads = self._cached_grads
+            ogs = tuple(_ones_like_val(o) for o in self._output_nds)
         else:
-            if out_grads is None:
-                ogs = [_ones_like_val(o) for o in self._output_nds]
-            else:
-                if isinstance(out_grads, NDArray):
-                    out_grads = [out_grads]
-                ogs = [g.value for g in out_grads]
-            argv = self._arg_values()
-            gargs = {n: argv[n] for n in gnames}
-            oargs = {n: v for n, v in argv.items() if n not in gargs}
-            fn = self._get_jit("fused")
-            # Reuse the forward pass's RNG key so stochastic ops (Dropout,
-            # rrelu) see the same masks the caller's out_grads were computed
-            # against (parity: the reference reuses the stored forward masks).
-            rng = self._last_rng if self._last_rng is not None \
-                else _random.next_key()
-            _, _, grads = fn(gargs, oargs, self._aux_values(), rng, ogs)
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            ogs = tuple(g.value for g in out_grads)
+        if self._pullback is None:
+            raise MXNetError(
+                "backward() requires a preceding forward(is_train=True)")
+        grads = self._pullback(ogs)[0]
         for name in gnames:
             req = self.grad_req[name]
             tgt = self.grad_dict[name]
@@ -388,13 +401,12 @@ class Executor(object):
             elif req == "add":
                 tgt._set_value(tgt.value + grads[name])
 
-    def _forward_eager(self, is_train, rng):
+    def _forward_eager(self, is_train, rng, monitor=False):
         """Eager multi-device walk for group2ctx model parallelism: every op runs
         on the device of its (committed) inputs; ctx_group changes insert
         device transfers (parity: PlaceDevice + _CrossDeviceCopy)."""
         import jax
         low = self._low
-        dev_of = {}
 
         def want_dev(node):
             grp = node.attr.get("ctx_group")
@@ -430,6 +442,10 @@ class Executor(object):
             n_vis = node.op.num_outputs_for(node.params)
             for i in range(n_vis):
                 values[(id(node), i)] = out[i]
+                if monitor:
+                    nm = node.name + ("_output" if n_vis == 1
+                                      else "_output%d" % i)
+                    self._monitor_cb(nm, NDArray(out[i]))
             if node.op.num_aux and is_train:
                 names = node.op.arg_names_for(node.params)
                 aux_pos = [i for i, nm in enumerate(names)
@@ -440,7 +456,7 @@ class Executor(object):
                         aux_updates[child.name] = out[n_vis + k]
         outs = [values[k] for k in low.out_keys]
         if is_train and self._grad_arg_names():
-            # eager vjp across devices
+            # eager vjp across devices; the pullback is cached for backward
             gnames = self._grad_arg_names()
 
             def f(gargs):
@@ -450,8 +466,7 @@ class Executor(object):
                 return tuple(o)
             primals = {n: self.arg_dict[n].value for n in gnames}
             _, vjp_fn = jax.vjp(f, primals)
-            ogs = tuple(_ones_like_val(v) for v in outs)
-            self._cached_grads = vjp_fn(ogs)[0]
+            self._pullback = vjp_fn
         return outs, aux_updates
 
     # ---------------------------------------------------------------- utility
@@ -495,26 +510,16 @@ class Executor(object):
         for name, shape in zip(self.aux_names, aux_shapes):
             cur = self.aux_dict[name]
             auxs[name] = cur if tuple(cur.shape) == tuple(shape) else \
-                nd.zeros(shape, ctx=cur.context)
+                nd.zeros(shape, ctx=cur.context, dtype=cur.dtype)
         return Executor(self._symbol, self._ctx, args, grads, self.grad_req,
                         auxs, group2ctx=self._group2ctx)
 
     def set_monitor_callback(self, callback):
-        """Install per-op output monitor (parity: MXExecutorSetMonitorCallback)."""
+        """Install per-op output monitor (parity: MXExecutorSetMonitorCallback).
+        Stats are collected from the one real execution (the lowered graph
+        returns every internal op output alongside the heads) — no second
+        pass, no divergent RNG."""
         self._monitor_cb = callback
-
-    def _run_monitor(self, is_train, rng):
-        low = self._low
-        internals = self._symbol.get_internals()
-        ex_low = _Lowered(internals)
-        outs, _ = ex_low.run(self._arg_values(), self._aux_values(), rng,
-                             is_train)
-        for (node, idx), val in zip(internals._outputs, outs):
-            if node.is_var:
-                continue
-            name = node.name + ("_output" if node.num_outputs() == 1
-                                else "_output%d" % idx)
-            self._monitor_cb(name, NDArray(val))
 
     def debug_str(self):
         return self._symbol.debug_str()

@@ -77,18 +77,26 @@ class KVStore(object):
         (parity: kvstore.push → KVStoreLocal::Push / KVStoreDist::Push)."""
         keys, single = _key_list(key)
         values = _value_list(value, len(keys), single)
+        # group duplicate keys: their merged values sum (parity:
+        # KVStoreLocal::GroupKVPairs), updater runs once per unique key
         merged_by_key = {}
+        uniq = []
         for k, vlist in zip(keys, values):
             if not isinstance(vlist, list):
                 vlist = [vlist]
-            merged_by_key[k] = _reduce(vlist)
+            m = _reduce(vlist)
+            if k in merged_by_key:
+                merged_by_key[k] = merged_by_key[k] + m
+            else:
+                merged_by_key[k] = m
+                uniq.append(k)
         if self.type.startswith("dist"):
             # all keys of this push cross the workers in ONE fused XLA
             # all-reduce (parity: the reference batches per-key ZPush engine
             # ops; here the batching is a single compiled collective)
             from .parallel import dist as _dist
             merged_by_key = _dist.allreduce_tree(merged_by_key)
-        for k in keys:
+        for k in uniq:
             merged = merged_by_key[k]
             if self._updater is not None:
                 if k not in self._store:

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import signal
 import socket
 import subprocess
@@ -74,11 +75,13 @@ def launch_ssh(hosts, command, env_extra=None):
                "MXTPU_NUM_PROCESSES": str(len(hosts)),
                "MXTPU_PROCESS_ID": str(rank)}
         env.update(env_extra or {})
-        env_str = " ".join("%s=%s" % kv for kv in env.items())
+        env_str = " ".join("%s=%s" % (k, shlex.quote(v))
+                           for k, v in env.items())
+        cmd_str = " ".join(shlex.quote(c) for c in command)
         procs.append(subprocess.Popen(
             ["ssh", "-o", "StrictHostKeyChecking=no", host,
-             "cd %s && env %s %s" % (os.getcwd(), env_str,
-                                     " ".join(command))]))
+             "cd %s && env %s %s" % (shlex.quote(os.getcwd()), env_str,
+                                     cmd_str)]))
     rc = 0
     for p in procs:
         rc = rc or p.wait()
