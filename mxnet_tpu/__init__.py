@@ -33,6 +33,7 @@ from . import module
 from . import parallel
 from .module import Module
 from . import monitor
+from . import operator
 from .monitor import Monitor
 from . import visualization
 from . import visualization as viz

@@ -6,6 +6,7 @@ from . import alexnet
 from . import resnet
 from . import inception_v3
 from . import vgg
+from . import ssd
 
 get_lenet = lenet.get_symbol
 get_mlp = mlp.get_symbol

@@ -1,0 +1,112 @@
+"""Custom operators written in Python (parity: reference
+python/mxnet/operator.py:226-460 CustomOp/CustomOpProp + the C callback
+bridge src/operator/custom.cc:187).
+
+TPU-native design: the reference marshals NDArray handles through a C
+callback table into the frontend; here the custom op's Python forward/
+backward run as host callbacks (``jax.pure_callback``) embedded in the
+lowered XLA computation, and ``jax.custom_vjp`` routes the graph's
+cotangents through the user's ``backward``.  The engine-serialised ordering
+the reference needs (custom.cc pushes ops with explicit var deps) is
+inherited from XLA's data dependencies on the callback's inputs/outputs.
+
+The legacy PythonOp/NumpyOp/NDArrayOp generations (operator.py:19-226) are
+an intentional drop — CustomOp is their successor and the only mechanism
+forward-ported.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+
+from .base import MXNetError, Registry
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_cls"]
+
+_CUSTOM = Registry("custom_op")
+
+
+class CustomOp(object):
+    """Base class for a custom operator instance (parity: operator.py
+    CustomOp).  Subclasses implement forward/backward with NDArray in/out
+    lists and use ``assign`` to honour the req (write/add/null) semantics."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write src into dst honouring req (parity: CustomOp.assign)."""
+        if req in ("null", None):
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError("unknown req %s" % req)
+
+
+class CustomOpProp(object):
+    """Operator properties: arity, shapes, types, instance factory (parity:
+    operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad():
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp subclass under ``reg_name``
+    (parity: mx.operator.register); usable afterwards as
+    ``mx.sym.Custom(..., op_type=reg_name)``."""
+
+    def deco(prop_cls):
+        _CUSTOM.register(reg_name, prop_cls, override=True)
+        # invalidate cached props/instances built from a previous class
+        from .ops import custom as _custom_op
+        _custom_op._PROP_CACHE.clear()
+        _custom_op._OP_CACHE.clear()
+        return prop_cls
+
+    return deco
+
+
+def get_prop_cls(op_type):
+    cls = _CUSTOM.find(op_type)
+    if cls is None:
+        raise MXNetError("custom op type %r not registered "
+                         "(use mx.operator.register)" % op_type)
+    return cls

@@ -18,3 +18,6 @@ from . import loss           # noqa: F401  (softmax_output/regression/make_loss/
 from . import optimizer_ops  # noqa: F401  (optimizer_op.cc)
 from . import sequence       # noqa: F401  (sequence_*.cc)
 from . import rnn_op         # noqa: F401  (rnn.cc / cudnn_rnn-inl.h)
+from . import spatial        # noqa: F401  (crop/grid/bilinear/st/roi/correlation)
+from . import contrib        # noqa: F401  (multibox_*, proposal, ctc_loss)
+from . import custom         # noqa: F401  (Custom — python callback op)

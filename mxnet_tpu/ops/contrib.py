@@ -1,0 +1,484 @@
+"""Contrib operators: SSD multibox family, Faster-RCNN Proposal, CTCLoss
+(parity: reference src/operator/contrib/{multibox_prior,multibox_target,
+multibox_detection,proposal}-inl.h; CTC parity target is the warpctc plugin,
+reference plugin/warpctc).
+
+TPU-first notes:
+- The reference's per-anchor CPU loops (bipartite matching, greedy NMS) become
+  fixed-shape lax.scan/fori_loop programs: every tensor keeps a static shape,
+  "removed" boxes are masked with -1/-inf instead of compacted, so the whole
+  op jits into one XLA computation and vmaps over the batch.
+- CTC's forward-backward is a lax.scan over time of the standard log-semiring
+  recursion; the gradient falls out of autodiff instead of a hand-written
+  backward kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import (register, parse_bool, parse_float, parse_int,
+                       parse_tuple)
+
+
+def _parse_floats(v):
+    if v is None:
+        return v
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    if isinstance(v, (list, tuple)):
+        return tuple(float(x) for x in v)
+    import ast
+    out = ast.literal_eval(v.strip())
+    if isinstance(out, (int, float)):
+        return (float(out),)
+    return tuple(float(x) for x in out)
+
+
+# -------------------------------------------------------------- MultiBoxPrior
+def _mbprior_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, [None], None
+    sizes = _parse_floats(attrs.get("sizes", (1.0,)))
+    ratios = _parse_floats(attrs.get("ratios", (1.0,)))
+    per = len(sizes) + len(ratios) - 1
+    h, w = data[2], data[3]
+    return list(in_shapes), [(1, h * w * per, 4)], None
+
+
+@register("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",),
+          attr_types={"sizes": _parse_floats, "ratios": _parse_floats,
+                      "clip": parse_bool},
+          defaults={"sizes": (1.0,), "ratios": (1.0,), "clip": False},
+          infer_shape=_mbprior_infer)
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False):
+    """Generate SSD anchor boxes for every feature-map pixel (parity:
+    multibox_prior.cc: per pixel, one box per size at ratio 1 then one box
+    per extra ratio at sizes[0]; corners normalised to [0,1])."""
+    h, w = int(data.shape[2]), int(data.shape[3])
+    dt = jnp.float32
+    cx = (jnp.arange(w, dtype=dt) + 0.5) / w        # (W,)
+    cy = (jnp.arange(h, dtype=dt) + 0.5) / h        # (H,)
+    half = []
+    for s in sizes:
+        half.append((s / 2.0, s / 2.0))
+    for r in ratios[1:]:
+        rs = float(_np.sqrt(r))
+        half.append((sizes[0] * rs / 2.0, sizes[0] / rs / 2.0))
+    hw = jnp.asarray(half, dt)                      # (P, 2) [w/2, h/2]
+    gx = jnp.broadcast_to(cx[None, :, None], (h, w, hw.shape[0]))
+    gy = jnp.broadcast_to(cy[:, None, None], (h, w, hw.shape[0]))
+    boxes = jnp.stack([gx - hw[:, 0], gy - hw[:, 1],
+                       gx + hw[:, 0], gy + hw[:, 1]], axis=-1)
+    boxes = boxes.reshape((1, h * w * hw.shape[0], 4))
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+# --------------------------------------------------------------- box helpers
+def _iou_matrix(a, b):
+    """IoU between (A,4) and (B,4) corner boxes (0 when union <= 0)."""
+    ix = jnp.maximum(0.0, jnp.minimum(a[:, None, 2], b[None, :, 2])
+                     - jnp.maximum(a[:, None, 0], b[None, :, 0]))
+    iy = jnp.maximum(0.0, jnp.minimum(a[:, None, 3], b[None, :, 3])
+                     - jnp.maximum(a[:, None, 1], b[None, :, 1]))
+    inter = ix * iy
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _encode_loc(anchors, gt, variances):
+    """Box-regression targets (parity: AssignLocTargets)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    gw = gt[:, 2] - gt[:, 0]
+    gh = gt[:, 3] - gt[:, 1]
+    gx = (gt[:, 0] + gt[:, 2]) * 0.5
+    gy = (gt[:, 1] + gt[:, 3]) * 0.5
+    vx, vy, vw, vh = variances
+    eps = 1e-8
+    return jnp.stack([(gx - ax) / jnp.maximum(aw, eps) / vx,
+                      (gy - ay) / jnp.maximum(ah, eps) / vy,
+                      jnp.log(jnp.maximum(gw / jnp.maximum(aw, eps), eps)) / vw,
+                      jnp.log(jnp.maximum(gh / jnp.maximum(ah, eps), eps)) / vh],
+                     axis=1)
+
+
+# -------------------------------------------------------------- MultiBoxTarget
+def _mbtarget_infer(attrs, in_shapes):
+    anchors, labels, cls_preds = (list(in_shapes) + [None] * 3)[:3]
+    if anchors is None or labels is None:
+        return list(in_shapes), [None, None, None], None
+    na = anchors[1]
+    b = labels[0]
+    return list(in_shapes), [(b, na * 4), (b, na * 4), (b, na)], None
+
+
+@register("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",),
+          arg_names=("anchor", "label", "cls_pred"), num_outputs=3,
+          attr_types={"overlap_threshold": parse_float,
+                      "ignore_label": parse_float,
+                      "negative_mining_ratio": parse_float,
+                      "negative_mining_thresh": parse_float,
+                      "minimum_negative_samples": parse_int,
+                      "variances": _parse_floats},
+          defaults={"overlap_threshold": 0.5, "ignore_label": -1.0,
+                    "negative_mining_ratio": -1.0,
+                    "negative_mining_thresh": 0.5,
+                    "minimum_negative_samples": 0,
+                    "variances": (0.1, 0.1, 0.2, 0.2)},
+          infer_shape=_mbtarget_infer)
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets (parity: multibox_target.cc): bipartite matching
+    (each GT claims its best anchor), then per-anchor threshold matching,
+    optional hard-negative mining on the background confidence, box-target
+    encoding with variances.  Outputs (loc_target (B, A*4), loc_mask (B, A*4),
+    cls_target (B, A)); cls_target is gt_class+1 for positives, 0 for
+    negatives, ignore_label for don't-care."""
+    anchors = anchor.reshape((-1, 4))
+    na = anchors.shape[0]
+    nl = label.shape[1]
+
+    def one(labels_b, cls_pred_b):
+        valid = labels_b[:, 0] >= 0                       # (L,)
+        gt = labels_b[:, 1:5]
+        overlaps = _iou_matrix(anchors, gt)               # (A, L)
+        overlaps = jnp.where(valid[None, :], overlaps, -1.0)
+
+        # stage 1: bipartite matching, nl rounds of global argmax
+        def bip(state, _):
+            match, a_used, g_used = state
+            masked = jnp.where(a_used[:, None] | g_used[None, :],
+                               -1.0, overlaps)
+            flat = jnp.argmax(masked)
+            ai, gi = flat // nl, flat % nl
+            good = masked[ai, gi] > 1e-6
+            match = jnp.where(good, match.at[ai].set(gi), match)
+            a_used = jnp.where(good, a_used.at[ai].set(True), a_used)
+            g_used = jnp.where(good, g_used.at[gi].set(True), g_used)
+            return (match, a_used, g_used), None
+
+        match0 = jnp.full((na,), -1, jnp.int32)
+        (match, a_used, _), _ = jax.lax.scan(
+            bip, (match0, jnp.zeros((na,), bool), jnp.zeros((nl,), bool)),
+            None, length=nl)
+
+        # stage 2: threshold matching for still-unmatched anchors
+        best_gt = jnp.argmax(overlaps, axis=1).astype(jnp.int32)
+        best_iou = jnp.max(overlaps, axis=1)
+        thresh_pos = (~a_used) & (best_iou > overlap_threshold) \
+            if overlap_threshold > 0 else jnp.zeros((na,), bool)
+        positive = a_used | thresh_pos
+        match = jnp.where(thresh_pos, best_gt, match)
+
+        # stage 3: negatives — all, or hard-mined by background confidence
+        if negative_mining_ratio > 0:
+            probs = jax.nn.softmax(cls_pred_b, axis=0)    # (num_cls, A)
+            neg_score = jnp.max(probs[1:], axis=0)        # best non-bg prob
+            cand = (~positive) & (best_iou < negative_mining_thresh)
+            num_pos = jnp.sum(positive)
+            num_neg = jnp.minimum(
+                jnp.maximum((num_pos * negative_mining_ratio)
+                            .astype(jnp.int32),
+                            minimum_negative_samples),
+                na - num_pos)
+            score = jnp.where(cand, neg_score, -jnp.inf)
+            order = jnp.argsort(-score)
+            rank = jnp.zeros((na,), jnp.int32).at[order].set(
+                jnp.arange(na, dtype=jnp.int32))
+            negative = cand & (rank < num_neg)
+        else:
+            negative = ~positive
+
+        cls_t = jnp.where(
+            positive, labels_b[match.clip(0), 0] + 1.0,
+            jnp.where(negative, 0.0, ignore_label))
+        loc_t = _encode_loc(anchors, gt[match.clip(0)], variances)
+        loc_t = jnp.where(positive[:, None], loc_t, 0.0)
+        loc_m = jnp.where(positive[:, None],
+                          jnp.ones((na, 4), anchors.dtype), 0.0)
+        any_gt = jnp.any(valid)
+        cls_t = jnp.where(any_gt, cls_t, 0.0)
+        loc_t = jnp.where(any_gt, loc_t, 0.0)
+        loc_m = jnp.where(any_gt, loc_m, 0.0)
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(label, cls_pred)
+    return loc_t, loc_m, cls_t
+
+
+# ---------------------------------------------------------- MultiBoxDetection
+def _mbdet_infer(attrs, in_shapes):
+    cls_prob = in_shapes[0]
+    if cls_prob is None:
+        return list(in_shapes), [None], None
+    return list(in_shapes), [(cls_prob[0], cls_prob[2], 6)], None
+
+
+def _greedy_nms(boxes, scores, ids, nms_threshold, force_suppress):
+    """Greedy NMS on score-sorted entries; suppressed entries get id -1
+    (parity: the detection output keeps static shape, invalid rows id=-1)."""
+    n = boxes.shape[0]
+
+    def body(i, ids):
+        alive_i = ids[i] >= 0
+
+        def suppress(ids):
+            iou = _iou_matrix(boxes[i][None], boxes)[0]   # (N,)
+            same = ids == ids[i] if not force_suppress else \
+                jnp.ones_like(ids, bool)
+            kill = (jnp.arange(n) > i) & (ids >= 0) & same \
+                & (iou >= nms_threshold)
+            return jnp.where(kill, -1.0, ids)
+        return jax.lax.cond(alive_i, suppress, lambda x: x, ids)
+
+    return jax.lax.fori_loop(0, n, body, ids)
+
+
+@register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",),
+          arg_names=("cls_prob", "loc_pred", "anchor"),
+          attr_types={"clip": parse_bool, "threshold": parse_float,
+                      "background_id": parse_int,
+                      "nms_threshold": parse_float,
+                      "force_suppress": parse_bool,
+                      "variances": _parse_floats},
+          defaults={"clip": True, "threshold": 0.01, "background_id": 0,
+                    "nms_threshold": 0.5, "force_suppress": False,
+                    "variances": (0.1, 0.1, 0.2, 0.2)},
+          infer_shape=_mbdet_infer)
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                        background_id=0, nms_threshold=0.5,
+                        force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2)):
+    """Decode SSD predictions into detections (parity: multibox_detection.cc).
+    Output (B, A, 6) rows [class_id, score, x1, y1, x2, y2], sorted by score,
+    suppressed/invalid rows have class_id -1."""
+    anchors = anchor.reshape((-1, 4))
+    vx, vy, vw, vh = variances
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+
+    def one(cls_p, loc_p):
+        # cls_p (num_cls, A), loc_p (A*4,)
+        lp = loc_p.reshape((-1, 4))
+        ox = lp[:, 0] * vx * aw + ax
+        oy = lp[:, 1] * vy * ah + ay
+        ow = jnp.exp(lp[:, 2] * vw) * aw / 2.0
+        oh = jnp.exp(lp[:, 3] * vh) * ah / 2.0
+        boxes = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class: mask the background row out of the
+        # argmax (the reference assumes background_id==0 and uses cls_p[1:])
+        masked = cls_p.at[background_id].set(-jnp.inf)
+        score = jnp.max(masked, axis=0)
+        raw = jnp.argmax(masked, axis=0)
+        # reported id skips over the background slot (bg=0 -> raw-1)
+        cid = jnp.where(raw > background_id, raw - 1, raw).astype(cls_p.dtype)
+        # reference: overall argmax must be non-background AND >= threshold
+        keep = (score > cls_p[background_id]) & (score >= threshold)
+        cid = jnp.where(keep, cid, -1.0)
+        score = jnp.where(keep, score, -1.0)
+        order = jnp.argsort(-score)
+        cid, score, boxes = cid[order], score[order], boxes[order]
+        cid = _greedy_nms(boxes, score, cid, nms_threshold, force_suppress)
+        score = jnp.where(cid >= 0, score, -1.0)
+        return jnp.concatenate([cid[:, None], score[:, None], boxes], axis=1)
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+# -------------------------------------------------------------------- Proposal
+def _gen_base_anchors(base_size, ratios, scales):
+    """py-faster-rcnn anchor enumeration (parity: proposal-inl.h
+    GenerateAnchors)."""
+    base = _np.array([0, 0, base_size - 1, base_size - 1], _np.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + (w - 1) * 0.5
+    cy = base[1] + (h - 1) * 0.5
+    out = []
+    size = w * h
+    for r in ratios:
+        ws = _np.round(_np.sqrt(size / r))
+        hs = _np.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            out.append([cx - (wss - 1) * 0.5, cy - (hss - 1) * 0.5,
+                        cx + (wss - 1) * 0.5, cy + (hss - 1) * 0.5])
+    return _np.array(out, _np.float32)
+
+
+def _proposal_infer(attrs, in_shapes):
+    cls = in_shapes[0]
+    if cls is None:
+        return list(in_shapes), [None], None
+    post = int(attrs.get("rpn_post_nms_top_n", 300))
+    n_out = 2 if parse_bool(attrs.get("output_score", False)) else 1
+    shapes = [(cls[0] * post, 5)]
+    if n_out == 2:
+        shapes.append((cls[0] * post, 1))
+    return list(in_shapes), shapes, None
+
+
+def _proposal_nout(attrs):
+    return 2 if parse_bool(attrs.get("output_score", False)) else 1
+
+
+@register("_contrib_Proposal", aliases=("Proposal",),
+          arg_names=("cls_prob", "bbox_pred", "im_info"),
+          num_outputs=_proposal_nout,
+          attr_types={"rpn_pre_nms_top_n": parse_int,
+                      "rpn_post_nms_top_n": parse_int,
+                      "threshold": parse_float, "rpn_min_size": parse_int,
+                      "scales": _parse_floats, "ratios": _parse_floats,
+                      "feature_stride": parse_int, "output_score": parse_bool,
+                      "iou_loss": parse_bool},
+          defaults={"rpn_pre_nms_top_n": 6000, "rpn_post_nms_top_n": 300,
+                    "threshold": 0.7, "rpn_min_size": 16,
+                    "scales": (4.0, 8.0, 16.0, 32.0),
+                    "ratios": (0.5, 1.0, 2.0), "feature_stride": 16,
+                    "output_score": False, "iou_loss": False},
+          infer_shape=_proposal_infer)
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4.0, 8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0),
+              feature_stride=16, output_score=False, iou_loss=False):
+    """RPN proposals (parity: proposal-inl.h/proposal.cc): enumerate shifted
+    anchors over the feature map, decode bbox deltas, clip to the image,
+    suppress boxes smaller than rpn_min_size (score := -inf, like the
+    reference's filter step), take pre-nms top-N, greedy NMS at `threshold`,
+    emit post-nms top-N rows [batch_idx, x1, y1, x2, y2]."""
+    if iou_loss:
+        raise MXNetError("Proposal: iou_loss=True not supported")
+    b, twoa, fh, fw = cls_prob.shape
+    A = twoa // 2
+    base = jnp.asarray(_gen_base_anchors(feature_stride, ratios, scales))
+    sx = jnp.arange(fw, dtype=jnp.float32) * feature_stride
+    sy = jnp.arange(fh, dtype=jnp.float32) * feature_stride
+    shift = jnp.stack(
+        [jnp.tile(sx, fh), jnp.repeat(sy, fw),
+         jnp.tile(sx, fh), jnp.repeat(sy, fw)], axis=1)    # (fh*fw, 4)
+    anchors = (base[None] + shift[:, None]).reshape((-1, 4))  # (fh*fw*A, 4)
+    n = anchors.shape[0]
+    pre_n = min(rpn_pre_nms_top_n, n) if rpn_pre_nms_top_n > 0 else n
+    post_n = rpn_post_nms_top_n
+
+    def one(scores_b, deltas_b, info):
+        # scores: fg scores are channels A..2A, layout (A, fh, fw)
+        scores = scores_b[A:].transpose((1, 2, 0)).reshape(-1)
+        deltas = deltas_b.reshape((A, 4, fh, fw)).transpose(
+            (2, 3, 0, 1)).reshape((-1, 4))
+        ih, iw, im_scale = info[0], info[1], info[2]
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        ax = anchors[:, 0] + aw * 0.5
+        ay = anchors[:, 1] + ah * 0.5
+        cx = deltas[:, 0] * aw + ax
+        cy = deltas[:, 1] * ah + ay
+        w = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
+        hh = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - 0.5 * (w - 1), cy - 0.5 * (hh - 1),
+                           cx + 0.5 * (w - 1), cy + 0.5 * (hh - 1)], axis=1)
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, iw - 1),
+                           jnp.clip(boxes[:, 1], 0, ih - 1),
+                           jnp.clip(boxes[:, 2], 0, iw - 1),
+                           jnp.clip(boxes[:, 3], 0, ih - 1)], axis=1)
+        min_size = rpn_min_size * im_scale
+        bw = boxes[:, 2] - boxes[:, 0] + 1
+        bh = boxes[:, 3] - boxes[:, 1] + 1
+        scores = jnp.where((bw >= min_size) & (bh >= min_size),
+                           scores, -jnp.inf)
+        top_scores, order = jax.lax.top_k(scores, pre_n)
+        top_boxes = boxes[order]
+        ids = jnp.zeros((pre_n,), jnp.float32)
+        ids = _greedy_nms(top_boxes, top_scores, ids, threshold, True)
+        # min-size-filtered boxes carry -inf scores: drop them too
+        alive = (ids >= 0) & jnp.isfinite(top_scores)
+        # stable order: alive first (already score-sorted)
+        sel = jnp.argsort(~alive, stable=True)[:post_n]
+        out_boxes = jnp.where(alive[sel][:, None], top_boxes[sel], 0.0)
+        out_scores = jnp.where(alive[sel], top_scores[sel], 0.0)
+        return out_boxes, out_scores
+
+    boxes, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(b, dtype=jnp.float32), post_n)
+    rois = jnp.concatenate([batch_idx[:, None],
+                            boxes.reshape((-1, 4))], axis=1)
+    if output_score:
+        return rois, scores.reshape((-1, 1))
+    return rois
+
+
+# -------------------------------------------------------------------- CTCLoss
+def _ctc_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return list(in_shapes), [None], None
+    return list(in_shapes), [(data[1],)], None
+
+
+@register("_contrib_CTCLoss", aliases=("CTCLoss", "ctc_loss"),
+          arg_names=("data", "label"), infer_shape=_ctc_infer)
+def _ctc_loss(data, label):
+    """Connectionist Temporal Classification loss (parity target: the
+    reference's warpctc plugin, plugin/warpctc).  data (T, B, A) activations
+    (softmax applied internally), label (B, L) with class ids in 1..A-1 and
+    0 padding; blank is 0.  Returns per-sequence negative log-likelihood
+    (B,); gradients come from autodiff of the scan."""
+    T, B, A = data.shape
+    L = label.shape[1]
+    log_probs = jax.nn.log_softmax(data, axis=2)
+    labels = label.astype(jnp.int32)                       # (B, L)
+    label_len = jnp.sum(labels > 0, axis=1)                # (B,)
+    S = 2 * L + 1
+    # extended label sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.zeros((B, S), jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    neg_inf = jnp.asarray(-1e30, log_probs.dtype)
+
+    # alpha init: positions 0 (blank) and 1 (first label)
+    init = jnp.full((B, S), neg_inf)
+    init = init.at[:, 0].set(log_probs[0, :, 0])
+    first = jnp.take_along_axis(log_probs[0], ext[:, 1:2], axis=1)[:, 0]
+    init = init.at[:, 1].set(jnp.where(label_len > 0, first, neg_inf))
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+    is_blank = ext == 0
+
+    def step(alpha, lp_t):
+        # lp_t: (B, A) log-probs at time t
+        a_prev1 = jnp.concatenate([jnp.full((B, 1), neg_inf),
+                                   alpha[:, :-1]], axis=1)
+        a_prev2 = jnp.concatenate([jnp.full((B, 2), neg_inf),
+                                   alpha[:, :-2]], axis=1)
+        # skip transition allowed only for non-blank, label != label-2
+        skip = jnp.where(is_blank | same_as_prev2, neg_inf, a_prev2)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a_prev1), skip)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)      # (B, S)
+        alpha = merged + emit
+        return alpha, None
+
+    alpha, _ = jax.lax.scan(step, init, log_probs[1:])
+    # total prob: final blank (position 2*len) or final label (2*len-1)
+    last_blank = jnp.take_along_axis(
+        alpha, (2 * label_len)[:, None], axis=1)[:, 0]
+    last_label = jnp.take_along_axis(
+        alpha, jnp.maximum(2 * label_len - 1, 0)[:, None], axis=1)[:, 0]
+    ll = jnp.logaddexp(last_blank,
+                       jnp.where(label_len > 0, last_label, neg_inf))
+    return -ll

@@ -1,0 +1,152 @@
+"""The `Custom` operator — runs a user-registered Python CustomOp inside the
+lowered XLA computation (parity: reference src/operator/custom.cc:187
+MXNET_REGISTER_OP_PROPERTY(Custom, CustomOpProp)).
+
+Forward and backward execute as host callbacks (jax.pure_callback);
+jax.custom_vjp routes autodiff through the user's backward.  Works both
+imperatively (mx.nd.Custom) and inside Symbol graphs/Executors — the callback
+is embedded in the jitted computation, ordered by its data dependencies.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import register, attr_key
+
+
+_PROP_CACHE = {}
+_OP_CACHE = {}
+
+
+def _split_attrs(attrs):
+    """Separate op_type from user kwargs (all values stringified, parity with
+    the reference passing kwargs as strings through the C API)."""
+    op_type = attrs.get("op_type")
+    if op_type is None:
+        raise MXNetError("Custom op requires op_type=")
+    user = {k: str(v) for k, v in attrs.items() if k != "op_type"}
+    return op_type, user
+
+
+def _get_prop(attrs):
+    key = attr_key(attrs)
+    prop = _PROP_CACHE.get(key)
+    if prop is None:
+        from .. import operator as _operator
+        op_type, user = _split_attrs(attrs)
+        prop = _operator.get_prop_cls(op_type)(**user)
+        _PROP_CACHE[key] = prop
+    return prop
+
+
+def _get_instance(attrs, in_shapes, in_dtypes):
+    # One instance per (attrs, shapes, dtypes): forward and backward
+    # callbacks of the same computation share it, so the common pattern of
+    # stashing residuals on self works.  (The reference creates one instance
+    # per executor; interleaving two same-shaped executors' forward passes
+    # before their backwards would share state here — a documented
+    # difference of the callback bridge.)
+    key = (attr_key(attrs), tuple(in_shapes),
+           tuple(str(d) for d in in_dtypes))
+    inst = _OP_CACHE.get(key)
+    if inst is None:
+        from ..context import current_context
+        prop = _get_prop(attrs)
+        inst = prop.create_operator(current_context(), list(in_shapes),
+                                    list(in_dtypes))
+        _OP_CACHE[key] = inst
+    return inst
+
+
+def _custom_arg_names(attrs):
+    return list(_get_prop(attrs).list_arguments())
+
+
+def _custom_num_outputs(attrs):
+    return len(_get_prop(attrs).list_outputs())
+
+
+def _custom_infer_shape(attrs, in_shapes):
+    prop = _get_prop(attrs)
+    if any(s is None for s in in_shapes):
+        return in_shapes, [None] * _custom_num_outputs(attrs), None
+    res = prop.infer_shape([list(s) for s in in_shapes])
+    ins, outs = res[0], res[1]
+    aux = res[2] if len(res) > 2 else []
+    return ([tuple(s) for s in ins], [tuple(s) for s in outs],
+            [tuple(s) for s in aux] or None)
+
+
+def _custom_infer_type(attrs, in_dtypes):
+    prop = _get_prop(attrs)
+    known = [d for d in in_dtypes if d is not None]
+    base = known[0] if known else _np.float32
+    res = prop.infer_type([d if d is not None else base for d in in_dtypes])
+    return list(res[0]), list(res[1]), list(res[2]) if len(res) > 2 else []
+
+
+def _wrap_nd(arrays):
+    from .. import ndarray as nd
+    return [nd.array(_np.asarray(a)) for a in arrays]
+
+
+@register("Custom", arg_names=_custom_arg_names,
+          num_outputs=_custom_num_outputs,
+          infer_shape=_custom_infer_shape, infer_type=_custom_infer_type,
+          train_aware=True)
+def _custom(*inputs, is_train=False, **attrs):
+    import jax
+    import jax.numpy as jnp
+
+    prop = _get_prop(attrs)
+    n_out = len(prop.list_outputs())
+    in_shapes = [tuple(x.shape) for x in inputs]
+    in_dtypes = [_np.dtype(x.dtype) for x in inputs]
+    _, out_shapes, _ = _custom_infer_shape(attrs, in_shapes)
+    _, out_dtypes, _ = _custom_infer_type(attrs, in_dtypes)
+    out_specs = tuple(jax.ShapeDtypeStruct(s, d)
+                      for s, d in zip(out_shapes, out_dtypes))
+    in_specs = tuple(jax.ShapeDtypeStruct(s, d)
+                     for s, d in zip(in_shapes, in_dtypes))
+
+    def fwd_host(*ins):
+        op = _get_instance(attrs, in_shapes, in_dtypes)
+        in_nd = _wrap_nd(ins)
+        from .. import ndarray as nd
+        out_nd = [nd.zeros(s, dtype=d)
+                  for s, d in zip(out_shapes, out_dtypes)]
+        op.forward(is_train=is_train, req=["write"] * n_out,
+                   in_data=in_nd, out_data=out_nd, aux=[])
+        return tuple(_np.asarray(o.asnumpy(), d)
+                     for o, d in zip(out_nd, out_dtypes))
+
+    def bwd_host(ins, outs, cts):
+        op = _get_instance(attrs, in_shapes, in_dtypes)
+        from .. import ndarray as nd
+        in_nd = _wrap_nd(ins)
+        out_nd = _wrap_nd(outs)
+        og_nd = _wrap_nd(cts)
+        grad_nd = [nd.zeros(s, dtype=d)
+                   for s, d in zip(in_shapes, in_dtypes)]
+        op.backward(req=["write"] * len(in_nd), out_grad=og_nd,
+                    in_data=in_nd, out_data=out_nd, in_grad=grad_nd, aux=[])
+        return tuple(_np.asarray(g.asnumpy(), d)
+                     for g, d in zip(grad_nd, in_dtypes))
+
+    @jax.custom_vjp
+    def run(*ins):
+        return jax.pure_callback(fwd_host, out_specs, *ins, vmap_method=None)
+
+    def run_fwd(*ins):
+        outs = run(*ins)
+        return outs, (ins, outs)
+
+    def run_bwd(res, cts):
+        ins, outs = res
+        return jax.pure_callback(bwd_host, in_specs, ins, outs, cts,
+                                 vmap_method=None)
+
+    run.defvjp(run_fwd, run_bwd)
+    outs = run(*inputs)
+    return outs if n_out > 1 else outs[0]

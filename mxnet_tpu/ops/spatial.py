@@ -1,0 +1,324 @@
+"""Spatial/warping operators (parity: reference src/operator/{crop,
+grid_generator,bilinear_sampler,spatial_transformer,roi_pooling,correlation}-inl.h).
+
+TPU-first notes:
+- Bilinear sampling is expressed as four vectorised gathers + a weighted sum
+  (jnp.take along flattened spatial indices) instead of the reference's
+  per-output-pixel scalar loops; XLA fuses the gathers, and the backward
+  (scatter-add of the four corner contributions) falls out of autodiff.
+- ROIPooling's dynamic per-ROI bins become a fixed-shape mask-and-max over the
+  whole feature map per (roi, bin): static shapes keep XLA happy and the MXU/
+  VPU saturated; R*PH*PW*H*W mask products are tiny next to conv FLOPs.
+- Correlation is a sum over the (2r+1)^2 displacement grid of shifted
+  elementwise products — a lax.conv-style static unroll, not a CUDA kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from .registry import (register, parse_bool, parse_float, parse_int,
+                       parse_str, parse_tuple)
+
+
+# ----------------------------------------------------------------------- Crop
+def _crop_args(attrs):
+    return ["data", "crop_like"] if int(attrs.get("num_args", 1)) > 1 \
+        else ["data"]
+
+
+def _crop_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, [None], None
+    h_w = parse_tuple(attrs.get("h_w", (0, 0)))
+    if int(attrs.get("num_args", 1)) > 1:
+        like = in_shapes[1]
+        if like is None:
+            return in_shapes, [None], None
+        out = (data[0], data[1], like[2], like[3])
+    else:
+        out = (data[0], data[1], h_w[0], h_w[1])
+    return list(in_shapes), [out], None
+
+
+@register("Crop", arg_names=_crop_args,
+          attr_types={"num_args": parse_int, "offset": parse_tuple,
+                      "h_w": parse_tuple, "center_crop": parse_bool},
+          defaults={"num_args": 1, "offset": (0, 0), "h_w": (0, 0),
+                    "center_crop": False},
+          infer_shape=_crop_infer, key_var_num_args="num_args")
+def _crop(data, crop_like=None, num_args=1, offset=(0, 0), h_w=(0, 0),
+          center_crop=False):
+    """Crop data to (h, w) of `h_w` or of `crop_like`'s spatial dims
+    (parity: crop-inl.h; crop_like receives zero gradient — jax stops the
+    gradient because only the *shape* is consumed)."""
+    if crop_like is not None:
+        oh, ow = int(crop_like.shape[2]), int(crop_like.shape[3])
+    else:
+        oh, ow = int(h_w[0]), int(h_w[1])
+    ih, iw = int(data.shape[2]), int(data.shape[3])
+    if center_crop:
+        y0, x0 = (ih - oh) // 2, (iw - ow) // 2
+    else:
+        y0, x0 = int(offset[0]), int(offset[1])
+    if y0 + oh > ih or x0 + ow > iw:
+        raise MXNetError("Crop: offset+size exceeds input (%d+%d>%d or "
+                         "%d+%d>%d)" % (y0, oh, ih, x0, ow, iw))
+    return data[:, :, y0:y0 + oh, x0:x0 + ow]
+
+
+# -------------------------------------------------------------- GridGenerator
+def _grid_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    tt = attrs.get("transform_type", "affine")
+    if data is None:
+        return in_shapes, [None], None
+    if tt == "affine":
+        th, tw = parse_tuple(attrs.get("target_shape", (0, 0)))
+        return list(in_shapes), [(data[0], 2, th, tw)], None
+    return list(in_shapes), [tuple(data)], None
+
+
+@register("GridGenerator",
+          attr_types={"transform_type": parse_str, "target_shape": parse_tuple},
+          defaults={"transform_type": "affine", "target_shape": (0, 0)},
+          infer_shape=_grid_infer)
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Generate a normalised sampling grid (parity: grid_generator-inl.h).
+
+    affine: data (N, 6) affine matrices -> grid (N, 2, H, W) with
+    grid[:,0]=x_src, grid[:,1]=y_src in [-1, 1].
+    warp: data (N, 2, H, W) optical flow -> grid_src = (flow + dst_index)
+    normalised to [-1, 1].
+    """
+    if transform_type == "affine":
+        th, tw = int(target_shape[0]), int(target_shape[1])
+        xs = -1.0 + jnp.arange(tw, dtype=data.dtype) * (2.0 / (tw - 1))
+        ys = -1.0 + jnp.arange(th, dtype=data.dtype) * (2.0 / (th - 1))
+        gx, gy = jnp.meshgrid(xs, ys)  # (H, W)
+        dst = jnp.stack([gx.ravel(), gy.ravel(),
+                         jnp.ones(th * tw, data.dtype)])  # (3, H*W)
+        theta = data.reshape((-1, 2, 3))
+        src = jnp.einsum("nij,jk->nik", theta, dst)  # (N, 2, H*W)
+        return src.reshape((-1, 2, th, tw))
+    if transform_type == "warp":
+        n, _, h, w = data.shape
+        gx = jnp.broadcast_to(jnp.arange(w, dtype=data.dtype), (h, w))
+        gy = jnp.broadcast_to(jnp.arange(h, dtype=data.dtype)[:, None], (h, w))
+        dst = jnp.stack([gx, gy])  # (2, H, W)
+        scale = jnp.array([(w - 1) / 2.0, (h - 1) / 2.0],
+                          data.dtype).reshape((1, 2, 1, 1))
+        return (data + dst[None]) / scale - 1.0
+    raise MXNetError("unknown transform_type %s" % transform_type)
+
+
+# ------------------------------------------------------------ BilinearSampler
+def _bilinear_sample(data, x_real, y_real):
+    """Sample data (N,C,H,W) at real coords x/y (N,P); zero outside borders
+    (matches the reference's `between` guards).  Returns (N, C, P)."""
+    n, c, ih, iw = data.shape
+    x0 = jnp.floor(x_real)
+    y0 = jnp.floor(y_real)
+    wx = x_real - x0
+    wy = y_real - y0
+    flat = data.reshape((n, c, ih * iw))
+
+    def corner(yc, xc, w):
+        inb = ((yc >= 0) & (yc < ih) & (xc >= 0) & (xc < iw))
+        yi = jnp.clip(yc.astype(_np.int32), 0, ih - 1)
+        xi = jnp.clip(xc.astype(_np.int32), 0, iw - 1)
+        idx = yi * iw + xi  # (N, P)
+        vals = jnp.take_along_axis(flat, idx[:, None, :], axis=2)  # (N,C,P)
+        return vals * (w * inb)[:, None, :]
+
+    return (corner(y0, x0, (1 - wy) * (1 - wx))
+            + corner(y0, x0 + 1, (1 - wy) * wx)
+            + corner(y0 + 1, x0, wy * (1 - wx))
+            + corner(y0 + 1, x0 + 1, wy * wx))
+
+
+def _bs_infer(attrs, in_shapes):
+    data, grid = (in_shapes + [None, None])[:2]
+    ins = list(in_shapes)
+    out = None
+    if data is not None and grid is not None:
+        out = (data[0], data[1], grid[2], grid[3])
+    return ins, [out], None
+
+
+@register("BilinearSampler", arg_names=("data", "grid"), infer_shape=_bs_infer)
+def _bilinear_sampler(data, grid):
+    """Sample data with a normalised grid (N,2,H',W'), grid[:,0]=x,
+    grid[:,1]=y in [-1,1] (parity: bilinear_sampler-inl.h; out-of-border
+    reads are zero, and gradients to data/grid follow from autodiff of the
+    gather-weighted sum)."""
+    n, _, oh, ow = grid.shape
+    ih, iw = data.shape[2], data.shape[3]
+    gx = grid[:, 0].reshape((n, oh * ow))
+    gy = grid[:, 1].reshape((n, oh * ow))
+    x_real = (gx + 1) * (iw - 1) / 2.0
+    y_real = (gy + 1) * (ih - 1) / 2.0
+    out = _bilinear_sample(data, x_real, y_real)
+    return out.reshape((n, data.shape[1], oh, ow))
+
+
+# --------------------------------------------------------- SpatialTransformer
+def _st_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    ins = list(in_shapes)
+    if data is not None:
+        ins[1] = (data[0], 6)
+    th, tw = parse_tuple(attrs.get("target_shape", (0, 0)))
+    out = None if data is None else (data[0], data[1], th, tw)
+    return ins, [out], None
+
+
+@register("SpatialTransformer", arg_names=("data", "loc"),
+          attr_types={"target_shape": parse_tuple, "transform_type": parse_str,
+                      "sampler_type": parse_str},
+          defaults={"target_shape": (0, 0), "transform_type": "affine",
+                    "sampler_type": "bilinear"},
+          infer_shape=_st_infer)
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine", sampler_type="bilinear"):
+    """Affine grid from loc (N,6) + bilinear sampling of data (parity:
+    spatial_transformer-inl.h = GridGenerator(affine) ∘ BilinearSampler)."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise MXNetError("SpatialTransformer supports affine/bilinear")
+    grid = _grid_generator(loc, transform_type="affine",
+                           target_shape=target_shape)
+    return _bilinear_sampler(data, grid)
+
+
+# ----------------------------------------------------------------- ROIPooling
+def _roi_infer(attrs, in_shapes):
+    data, rois = (list(in_shapes) + [None, None])[:2]
+    ph, pw = parse_tuple(attrs.get("pooled_size"))
+    out = None
+    if data is not None and rois is not None:
+        out = (rois[0], data[1], ph, pw)
+    return list(in_shapes), [out], None
+
+
+@register("ROIPooling", arg_names=("data", "rois"),
+          attr_types={"pooled_size": parse_tuple,
+                      "spatial_scale": parse_float},
+          infer_shape=_roi_infer)
+def _roi_pooling(data, rois, pooled_size=None, spatial_scale=1.0):
+    """Max-pool each ROI into a fixed (ph, pw) grid (parity: roi_pooling.cc
+    arithmetic: rounded roi corners, inclusive extent, floor/ceil bin edges,
+    empty bins = 0).  Vectorised as a mask-and-max over the feature map per
+    (roi, bin) — static shapes for XLA instead of dynamic slicing."""
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    n, c, h, w = data.shape
+    batch_idx = rois[:, 0].astype(_np.int32)  # (R,)
+    roi_start_w = jnp.round(rois[:, 1] * spatial_scale)
+    roi_start_h = jnp.round(rois[:, 2] * spatial_scale)
+    roi_end_w = jnp.round(rois[:, 3] * spatial_scale)
+    roi_end_h = jnp.round(rois[:, 4] * spatial_scale)
+    roi_h = jnp.maximum(roi_end_h - roi_start_h + 1, 1.0)  # (R,)
+    roi_w = jnp.maximum(roi_end_w - roi_start_w + 1, 1.0)
+    bin_h = roi_h / ph
+    bin_w = roi_w / pw
+
+    phs = jnp.arange(ph, dtype=data.dtype)
+    pws = jnp.arange(pw, dtype=data.dtype)
+    # bin extents per (R, ph/pw), clipped to the map (same min/max order as
+    # the reference)
+    hstart = jnp.clip(jnp.floor(phs[None] * bin_h[:, None])
+                      + roi_start_h[:, None], 0, h)
+    hend = jnp.clip(jnp.ceil((phs[None] + 1) * bin_h[:, None])
+                    + roi_start_h[:, None], 0, h)
+    wstart = jnp.clip(jnp.floor(pws[None] * bin_w[:, None])
+                      + roi_start_w[:, None], 0, w)
+    wend = jnp.clip(jnp.ceil((pws[None] + 1) * bin_w[:, None])
+                    + roi_start_w[:, None], 0, w)
+
+    ys = jnp.arange(h, dtype=data.dtype)
+    xs = jnp.arange(w, dtype=data.dtype)
+    # mask (R, PH, H) x (R, PW, W) -> (R, PH, PW, H, W)
+    mask_h = ((ys[None, None] >= hstart[:, :, None])
+              & (ys[None, None] < hend[:, :, None]))
+    mask_w = ((xs[None, None] >= wstart[:, :, None])
+              & (xs[None, None] < wend[:, :, None]))
+    mask = mask_h[:, :, None, :, None] & mask_w[:, None, :, None, :]
+    feat = data[batch_idx]  # (R, C, H, W)
+    neg = jnp.asarray(-_np.inf, data.dtype)
+    masked = jnp.where(mask[:, None], feat[:, :, None, None], neg)
+    out = masked.max(axis=(4, 5))  # (R, C, PH, PW)
+    # empty bins (hend<=hstart) are 0 in the reference
+    return jnp.where(jnp.isfinite(out), out, jnp.zeros((), data.dtype))
+
+
+# ---------------------------------------------------------------- Correlation
+def _corr_geometry(attrs, dshape):
+    pad = int(attrs.get("pad_size", 0))
+    ks = int(attrs.get("kernel_size", 1))
+    md = int(attrs.get("max_displacement", 1))
+    s1 = int(attrs.get("stride1", 1))
+    s2 = int(attrs.get("stride2", 1))
+    kr = (ks - 1) // 2
+    border = md + kr
+    padded_h = dshape[2] + 2 * pad
+    padded_w = dshape[3] + 2 * pad
+    top_h = int(_np.ceil((padded_h - border * 2) / float(s1)))
+    top_w = int(_np.ceil((padded_w - border * 2) / float(s1)))
+    ngr = md // s2
+    ngw = ngr * 2 + 1
+    return pad, ks, md, s1, s2, kr, border, top_h, top_w, ngr, ngw
+
+
+def _corr_infer(attrs, in_shapes):
+    d1 = in_shapes[0]
+    if d1 is None:
+        return list(in_shapes), [None], None
+    (_, _, _, _, _, _, _, th, tw, _, ngw) = _corr_geometry(attrs, d1)
+    return list(in_shapes), [(d1[0], ngw * ngw, th, tw)], None
+
+
+@register("Correlation", arg_names=("data1", "data2"),
+          attr_types={"kernel_size": parse_int, "max_displacement": parse_int,
+                      "stride1": parse_int, "stride2": parse_int,
+                      "pad_size": parse_int, "is_multiply": parse_bool},
+          defaults={"kernel_size": 1, "max_displacement": 1, "stride1": 1,
+                    "stride2": 1, "pad_size": 0, "is_multiply": True},
+          infer_shape=_corr_infer)
+def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                 stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation layer (parity: correlation-inl.h).  One output
+    channel per displacement (s2o, s2p) in the neighbourhood grid; each is
+    mean over the kernel window and feature channels of data1·shift(data2)
+    (or |data1-shift(data2)| for is_multiply=False).  Implemented as a
+    static unroll over the displacement grid of fused shift+reduce ops."""
+    attrs = dict(kernel_size=kernel_size, max_displacement=max_displacement,
+                 stride1=stride1, stride2=stride2, pad_size=pad_size)
+    (pad, ks, md, s1, s2, kr, border, top_h, top_w, ngr,
+     ngw) = _corr_geometry(attrs, data1.shape)
+    n, c, _, _ = data1.shape
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    sumelems = ks * ks * c
+    chans = []
+    for pi in range(ngw):            # displacement rows (s2p)
+        for pj in range(ngw):        # displacement cols (s2o)
+            s2o = (pj - ngr) * s2
+            s2p = (pi - ngr) * s2
+            acc = 0
+            for kh in range(-kr, kr + 1):
+                for kw in range(-kr, kr + 1):
+                    # window around x1 = j*s1 + border (+ kernel offset)
+                    y1 = border + kh
+                    x1 = border + kw
+                    a = p1[:, :, y1:y1 + top_h * s1:s1,
+                           x1:x1 + top_w * s1:s1]
+                    b = p2[:, :, y1 + s2p:y1 + s2p + top_h * s1:s1,
+                           x1 + s2o:x1 + s2o + top_w * s1:s1]
+                    if is_multiply:
+                        acc = acc + (a * b).sum(axis=1)
+                    else:
+                        acc = acc + jnp.abs(a - b).sum(axis=1)
+            chans.append(acc / sumelems)
+    return jnp.stack(chans, axis=1)

@@ -1,0 +1,162 @@
+"""Contrib op tests: SSD multibox family, Proposal, CTCLoss (parity targets:
+reference src/operator/contrib/*.cc behaviors)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_multibox_prior_counts_and_first_box():
+    data = mx.nd.zeros((1, 3, 4, 6))
+    out = mx.nd.MultiBoxPrior(data, sizes=(0.5, 0.25), ratios=(1, 2, 0.5))
+    # per pixel: num_sizes + num_ratios - 1 = 4
+    assert out.shape == (1, 4 * 6 * 4, 4)
+    b = out.asnumpy()[0]
+    # first pixel center is (0.5/6, 0.5/4); first box is size 0.5 ratio 1
+    cx, cy = 0.5 / 6, 0.5 / 4
+    np.testing.assert_allclose(b[0], [cx - 0.25, cy - 0.25,
+                                      cx + 0.25, cy + 0.25], atol=1e-6)
+    # ratio-2 box: half-w = s*sqrt(2)/2, half-h = s/sqrt(2)/2, s = sizes[0]
+    hw = 0.5 * np.sqrt(2.0) / 2
+    hh = 0.5 / np.sqrt(2.0) / 2
+    np.testing.assert_allclose(b[2], [cx - hw, cy - hh, cx + hw, cy + hh],
+                               atol=1e-6)
+
+
+def test_multibox_prior_clip():
+    data = mx.nd.zeros((1, 3, 2, 2))
+    out = mx.nd.MultiBoxPrior(data, sizes=(1.5,), clip=True).asnumpy()
+    assert out.min() >= 0.0 and out.max() <= 1.0
+
+
+def test_multibox_target_perfect_match():
+    # one anchor exactly equals the one GT box -> positive with class 0+1
+    anchors = mx.nd.array(np.array(
+        [[[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]]], np.float32))
+    labels = mx.nd.array(np.array(
+        [[[0, 0.1, 0.1, 0.4, 0.4]]], np.float32))
+    cls_preds = mx.nd.zeros((1, 3, 2))
+    loc_t, loc_m, cls_t = mx.nd.MultiBoxTarget(anchors, labels, cls_preds)
+    np.testing.assert_array_equal(cls_t.asnumpy(), [[1, 0]])
+    np.testing.assert_array_equal(loc_m.asnumpy(),
+                                  [[1, 1, 1, 1, 0, 0, 0, 0]])
+    # exact match -> zero encoded offsets
+    np.testing.assert_allclose(loc_t.asnumpy()[0, :4], np.zeros(4),
+                               atol=1e-5)
+
+
+def test_multibox_target_encoding_math():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5]]], np.float32)
+    labels = np.array([[[2, 0.1, 0.1, 0.6, 0.6]]], np.float32)
+    loc_t, loc_m, cls_t = mx.nd.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(labels), mx.nd.zeros((1, 4, 1)))
+    np.testing.assert_array_equal(cls_t.asnumpy(), [[3]])  # class 2 + 1
+    # encode: both centers (0.25,0.25) vs (0.35,0.35), aw=ah=0.5, gw=gh=0.5
+    v = (0.1, 0.1, 0.2, 0.2)
+    tx = (0.35 - 0.25) / 0.5 / v[0]
+    np.testing.assert_allclose(loc_t.asnumpy()[0],
+                               [tx, tx, 0.0, 0.0], atol=1e-4)
+
+
+def test_multibox_target_no_gt():
+    anchors = mx.nd.array(np.array([[[0.1, 0.1, 0.4, 0.4]]], np.float32))
+    labels = mx.nd.array(np.array([[[-1, 0, 0, 0, 0]]], np.float32))
+    loc_t, loc_m, cls_t = mx.nd.MultiBoxTarget(anchors, labels,
+                                               mx.nd.zeros((1, 2, 1)))
+    assert cls_t.asnumpy().sum() == 0
+    assert loc_m.asnumpy().sum() == 0
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.11, 0.11, 0.41, 0.41],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    # class probs (B, num_cls+1, A): anchor0/1 class1, anchor2 class2
+    cls_prob = np.array([[[0.1, 0.2, 0.2],
+                          [0.8, 0.7, 0.1],
+                          [0.1, 0.1, 0.7]]], np.float32)
+    loc_pred = np.zeros((1, 12), np.float32)
+    out = mx.nd.MultiBoxDetection(mx.nd.array(cls_prob),
+                                  mx.nd.array(loc_pred),
+                                  mx.nd.array(anchors),
+                                  nms_threshold=0.5).asnumpy()[0]
+    assert out.shape == (3, 6)
+    kept = out[out[:, 0] >= 0]
+    # anchor1 suppressed by anchor0 (same class, IoU ~0.88)
+    assert len(kept) == 2
+    ids = sorted(kept[:, 0].tolist())
+    assert ids == [0.0, 1.0]
+    # zero loc_pred -> boxes equal anchors
+    best = kept[np.argmax(kept[:, 1])]
+    np.testing.assert_allclose(best[2:], [0.1, 0.1, 0.4, 0.4], atol=1e-5)
+
+
+def test_multibox_detection_threshold():
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4]]], np.float32)
+    cls_prob = np.array([[[0.99], [0.01]]], np.float32)
+    out = mx.nd.MultiBoxDetection(mx.nd.array(cls_prob),
+                                  mx.nd.zeros((1, 4)),
+                                  mx.nd.array(anchors),
+                                  threshold=0.5).asnumpy()[0]
+    assert (out[:, 0] == -1).all()
+
+
+def test_proposal_shapes_and_clip():
+    rs = np.random.RandomState(0)
+    b, a, fh, fw = 1, 3, 4, 4
+    cls_prob = rs.rand(b, 2 * a, fh, fw).astype(np.float32)
+    bbox_pred = (rs.rand(b, 4 * a, fh, fw).astype(np.float32) - 0.5) * 0.1
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    rois = mx.nd.Proposal(mx.nd.array(cls_prob), mx.nd.array(bbox_pred),
+                          mx.nd.array(im_info), rpn_pre_nms_top_n=12,
+                          rpn_post_nms_top_n=5, feature_stride=16,
+                          scales=(2.0,), ratios=(0.5, 1.0, 2.0),
+                          rpn_min_size=4).asnumpy()
+    assert rois.shape == (5, 5)
+    assert (rois[:, 0] == 0).all()
+    assert rois[:, 1:].min() >= 0 and rois[:, 1:].max() <= 63
+
+
+def test_proposal_output_score():
+    cls_prob = mx.nd.ones((1, 2, 2, 2)) * 0.5
+    bbox_pred = mx.nd.zeros((1, 4, 2, 2))
+    im_info = mx.nd.array(np.array([[32, 32, 1.0]], np.float32))
+    out = mx.nd.Proposal(cls_prob, bbox_pred, im_info, rpn_post_nms_top_n=3,
+                         scales=(1.0,), ratios=(1.0,), output_score=True)
+    assert isinstance(out, (list, tuple)) and len(out) == 2
+    assert out[0].shape == (3, 5) and out[1].shape == (3, 1)
+
+
+def _ctc_brute_force(probs, label):
+    """Sum over all alignments (tiny cases only). probs (T, A) softmaxed."""
+    import itertools
+    T, A = probs.shape
+
+    def collapse(path):
+        out = []
+        prev = -1
+        for p in path:
+            if p != prev and p != 0:
+                out.append(p)
+            prev = p
+        return tuple(out)
+
+    total = 0.0
+    for path in itertools.product(range(A), repeat=T):
+        if collapse(path) == tuple(label):
+            p = 1.0
+            for t, s in enumerate(path):
+                p *= probs[t, s]
+            total += p
+    return total
+
+
+def test_ctc_loss_vs_brute_force():
+    rs = np.random.RandomState(0)
+    T, B, A = 4, 2, 3
+    acts = rs.randn(T, B, A).astype(np.float32)
+    labels = np.array([[1, 2], [1, 0]], np.float32)  # second has len 1
+    loss = mx.nd.CTCLoss(mx.nd.array(acts), mx.nd.array(labels)).asnumpy()
+    probs = np.exp(acts) / np.exp(acts).sum(axis=2, keepdims=True)
+    for i, lab in enumerate([[1, 2], [1]]):
+        expect = -np.log(_ctc_brute_force(probs[:, i], lab))
+        np.testing.assert_allclose(loss[i], expect, rtol=1e-4)

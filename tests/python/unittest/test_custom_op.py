@@ -1,0 +1,76 @@
+"""CustomOp mechanism tests (parity: reference test_operator.py
+test_custom_op — python forward/backward round-trip through the graph)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+@mx.operator.register("sqr")
+class SqrProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return Sqr()
+
+
+class Sqr(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    2 * in_data[0] * out_grad[0])
+
+
+def test_custom_imperative():
+    x = mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    y = mx.nd.Custom(x, op_type="sqr")
+    np.testing.assert_allclose(y.asnumpy(), [1, 4, 9], rtol=1e-6)
+
+
+def test_custom_symbolic_forward_backward():
+    data = mx.sym.Variable("data")
+    y = mx.sym.Custom(data, op_type="sqr", name="sqr0")
+    ex = y.bind(mx.cpu(), {"data": mx.nd.array([1.0, 2.0, 3.0])},
+                args_grad={"data": mx.nd.zeros((3,))})
+    out = ex.forward(is_train=True)
+    np.testing.assert_allclose(out[0].asnumpy(), [1, 4, 9], rtol=1e-6)
+    ex.backward(out_grads=mx.nd.array([1.0, 1.0, 1.0]))
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), [2, 4, 6],
+                               rtol=1e-6)
+
+
+def test_custom_in_composed_graph():
+    """Custom op feeding a FullyConnected — gradient chains through both."""
+    data = mx.sym.Variable("data")
+    sq = mx.sym.Custom(data, op_type="sqr")
+    fc = mx.sym.FullyConnected(sq, num_hidden=1, no_bias=True, name="fc")
+    ex = fc.bind(mx.cpu(), {"data": mx.nd.array([[1.0, 2.0]]),
+                            "fc_weight": mx.nd.array([[3.0, 4.0]])},
+                 args_grad={"data": mx.nd.zeros((1, 2)),
+                            "fc_weight": mx.nd.zeros((1, 2))})
+    out = ex.forward(is_train=True)
+    np.testing.assert_allclose(out[0].asnumpy(), [[3 + 16]], rtol=1e-6)
+    ex.backward(out_grads=mx.nd.ones((1, 1)))
+    # d/dx (w . x^2) = 2 w x
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               [[6.0, 16.0]], rtol=1e-6)
+    np.testing.assert_allclose(ex.grad_dict["fc_weight"].asnumpy(),
+                               [[1.0, 4.0]], rtol=1e-6)
+
+
+def test_custom_shape_inference():
+    data = mx.sym.Variable("data")
+    y = mx.sym.Custom(data, op_type="sqr")
+    _, out_shapes, _ = y.infer_shape(data=(4, 5))
+    assert out_shapes[0] == (4, 5)

@@ -1,0 +1,144 @@
+"""Spatial/warping op tests (parity targets: reference test_operator.py
+crop/grid/sampler cases and the op kernels in src/operator/*.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def test_crop_offset():
+    x = mx.nd.array(np.arange(2 * 3 * 6 * 8, dtype=np.float32)
+                    .reshape(2, 3, 6, 8))
+    out = mx.nd.Crop(x, h_w=(4, 5), offset=(1, 2), num_args=1)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  x.asnumpy()[:, :, 1:5, 2:7])
+
+
+def test_crop_center():
+    x = mx.nd.array(np.arange(1 * 1 * 8 * 8, dtype=np.float32)
+                    .reshape(1, 1, 8, 8))
+    out = mx.nd.Crop(x, h_w=(4, 4), center_crop=True, num_args=1)
+    np.testing.assert_array_equal(out.asnumpy(), x.asnumpy()[:, :, 2:6, 2:6])
+
+
+def test_crop_like_symbol():
+    data = mx.sym.Variable("data")
+    like = mx.sym.Variable("like")
+    c = mx.sym.Crop(data, like, num_args=2)
+    arg_shapes, out_shapes, _ = c.infer_shape(data=(1, 2, 8, 8),
+                                              like=(1, 2, 5, 6))
+    assert out_shapes[0] == (1, 2, 5, 6)
+    ex = c.bind(mx.cpu(), {"data": mx.nd.ones((1, 2, 8, 8)),
+                           "like": mx.nd.zeros((1, 2, 5, 6))},
+                args_grad={"data": mx.nd.zeros((1, 2, 8, 8)),
+                           "like": mx.nd.zeros((1, 2, 5, 6))})
+    ex.forward(is_train=True)
+    ex.backward(out_grads=mx.nd.ones((1, 2, 5, 6)))
+    # crop_like gets zero gradient (reference sets gcrop_like = 0)
+    np.testing.assert_array_equal(ex.grad_dict["like"].asnumpy(),
+                                  np.zeros((1, 2, 5, 6), np.float32))
+    g = ex.grad_dict["data"].asnumpy()
+    assert g[:, :, :5, :6].sum() == 2 * 5 * 6
+    assert g.sum() == 2 * 5 * 6
+
+
+def test_grid_generator_affine_identity():
+    # identity affine -> grid equals the normalised dst mesh
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    grid = mx.nd.GridGenerator(mx.nd.array(theta), transform_type="affine",
+                               target_shape=(3, 4)).asnumpy()
+    assert grid.shape == (2, 2, 3, 4)
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 4),
+                               atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 3),
+                               atol=1e-6)
+
+
+def test_grid_generator_warp_zero_flow():
+    flow = np.zeros((1, 2, 3, 5), np.float32)
+    grid = mx.nd.GridGenerator(mx.nd.array(flow),
+                               transform_type="warp").asnumpy()
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 5),
+                               atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 3),
+                               atol=1e-6)
+
+
+def test_bilinear_sampler_identity():
+    data = np.random.RandomState(0).rand(2, 3, 5, 7).astype(np.float32)
+    xs = np.linspace(-1, 1, 7, dtype=np.float32)
+    ys = np.linspace(-1, 1, 5, dtype=np.float32)
+    gx, gy = np.meshgrid(xs, ys)
+    grid = np.stack([gx, gy])[None].repeat(2, axis=0)
+    out = mx.nd.BilinearSampler(mx.nd.array(data), mx.nd.array(grid))
+    np.testing.assert_allclose(out.asnumpy(), data, rtol=1e-5, atol=1e-5)
+
+
+def test_bilinear_sampler_outside_is_zero():
+    data = np.ones((1, 1, 4, 4), np.float32)
+    grid = np.full((1, 2, 2, 2), 5.0, np.float32)  # far outside
+    out = mx.nd.BilinearSampler(mx.nd.array(data), mx.nd.array(grid))
+    np.testing.assert_array_equal(out.asnumpy(), np.zeros((1, 1, 2, 2)))
+
+
+def test_bilinear_sampler_grad():
+    data = mx.sym.Variable("data")
+    grid = mx.sym.Variable("grid")
+    net = mx.sym.BilinearSampler(data=data, grid=grid)
+    d = np.random.RandomState(1).rand(1, 2, 5, 5).astype(np.float32)
+    g = np.random.RandomState(2).uniform(-0.8, 0.8, (1, 2, 4, 4)) \
+        .astype(np.float32)
+    check_numeric_gradient(net, [d, g], numeric_eps=1e-3, rtol=0.05,
+                           atol=1e-2)
+
+
+def test_spatial_transformer_identity():
+    data = np.random.RandomState(0).rand(2, 1, 6, 6).astype(np.float32)
+    loc = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    out = mx.nd.SpatialTransformer(mx.nd.array(data), mx.nd.array(loc),
+                                   target_shape=(6, 6),
+                                   transform_type="affine",
+                                   sampler_type="bilinear")
+    np.testing.assert_allclose(out.asnumpy(), data, rtol=1e-5, atol=1e-5)
+
+
+def test_roi_pooling_basic():
+    # 1x1x6x6 map with ascending values; one ROI covering a known region
+    data = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    rois = np.array([[0, 0, 0, 5, 5]], np.float32)  # whole map
+    out = mx.nd.ROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                           pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    # bins: rows 0-2/3-5, cols 0-2/3-5 -> max at bottom-right of each bin
+    np.testing.assert_array_equal(
+        out[0, 0], np.array([[14, 17], [32, 35]], np.float32))
+
+
+def test_roi_pooling_batch_index_and_scale():
+    rs = np.random.RandomState(3)
+    data = rs.rand(2, 2, 8, 8).astype(np.float32)
+    rois = np.array([[1, 0, 0, 14, 14]], np.float32)  # second image, x0.5
+    out = mx.nd.ROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                           pooled_size=(1, 1), spatial_scale=0.5).asnumpy()
+    np.testing.assert_allclose(out[0, :, 0, 0], data[1].max(axis=(1, 2)),
+                               rtol=1e-6)
+
+
+def test_correlation_self_is_mean_square():
+    """Correlating a map with itself at zero displacement = mean over C of
+    x^2 at each pixel."""
+    rs = np.random.RandomState(0)
+    d = rs.rand(1, 3, 5, 5).astype(np.float32)
+    out = mx.nd.Correlation(mx.nd.array(d), mx.nd.array(d), kernel_size=1,
+                            max_displacement=0, stride1=1, stride2=1,
+                            pad_size=0, is_multiply=True).asnumpy()
+    assert out.shape == (1, 1, 5, 5)
+    np.testing.assert_allclose(out[0, 0], (d[0] ** 2).mean(axis=0),
+                               rtol=1e-5)
+
+
+def test_correlation_shape():
+    d = mx.nd.zeros((2, 4, 10, 10))
+    out = mx.nd.Correlation(d, d, kernel_size=1, max_displacement=2,
+                            stride1=1, stride2=1, pad_size=2)
+    assert out.shape == (2, 25, 10, 10)

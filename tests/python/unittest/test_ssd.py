@@ -1,0 +1,35 @@
+"""SSD end-to-end shape/step test (BASELINE config #4 — the SSD symbol
+binds, trains a step, and the detection symbol emits detections)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import ssd
+
+
+def test_ssd_train_step_and_detection():
+    net = ssd.get_symbol_train(num_classes=3)
+    b = 2
+    rs = np.random.RandomState(0)
+    data = rs.rand(b, 3, 64, 64).astype(np.float32)
+    label = np.full((b, 4, 5), -1.0, np.float32)
+    label[0, 0] = [1, 0.2, 0.2, 0.6, 0.6]
+    label[1, 0] = [0, 0.1, 0.3, 0.5, 0.8]
+    mod = mx.Module(net, data_names=("data",), label_names=("label",))
+    it = mx.io.NDArrayIter({"data": data}, {"label": label}, batch_size=b)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01})
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    outs = mod.get_outputs()
+    assert outs[0].shape == (b, 4, 1344)      # cls_prob
+    assert outs[1].shape == (b, 1344 * 4)     # loc loss
+    assert np.isfinite(outs[1].asnumpy()).all()
+
+    det = ssd.get_symbol(num_classes=3)
+    ex = det.simple_bind(mx.cpu(), data=(1, 3, 64, 64))
+    out = ex.forward(is_train=False)
+    assert out[0].shape == (1, 1344, 6)

@@ -84,7 +84,8 @@ def launch_ssh(hosts, command, env_extra=None):
                                      cmd_str)]))
     rc = 0
     for p in procs:
-        rc = rc or p.wait()
+        prc = p.wait()
+        rc = rc or prc
     return rc
 
 
