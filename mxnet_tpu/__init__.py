@@ -34,6 +34,7 @@ from . import parallel
 from .module import Module
 from . import monitor
 from . import operator
+from . import image
 from .monitor import Monitor
 from . import visualization
 from . import visualization as viz

@@ -406,6 +406,42 @@ class Executor(object):
         on the device of its (committed) inputs; ctx_group changes insert
         device transfers (parity: PlaceDevice + _CrossDeviceCopy)."""
         import jax
+        vals = self._arg_values()
+        aux_vals = self._aux_values()
+        gnames = self._grad_arg_names() if is_train else []
+        if gnames and not monitor:
+            # one walk only: jax.vjp evaluates the primal (through the
+            # device-placed _walk, incl. the _CrossDeviceCopy transfers) and
+            # hands back the pullback for backward()
+            def f(gargs):
+                merged = dict(vals)
+                merged.update(gargs)
+                o, aux_upd = self._walk(merged, aux_vals, rng, True, False)
+                return tuple(o), aux_upd
+            primals = {n: vals[n] for n in gnames}
+            outs, vjp_fn, aux_updates = jax.vjp(f, primals, has_aux=True)
+            self._pullback = vjp_fn
+            return list(outs), aux_updates
+        outs, aux_updates = self._walk(vals, aux_vals, rng, is_train,
+                                       monitor)
+        if gnames:
+            # monitor attached: the monitored walk ran eagerly above; trace
+            # a second walk for the pullback
+            def f(gargs):
+                merged = dict(vals)
+                merged.update(gargs)
+                o, _ = self._walk(merged, aux_vals, rng, True, False)
+                return tuple(o)
+            primals = {n: vals[n] for n in gnames}
+            _, vjp_fn = jax.vjp(f, primals)
+            self._pullback = vjp_fn
+        return outs, aux_updates
+
+    def _walk(self, vals, aux_vals, rng, is_train, monitor):
+        """Topo walk executing each op on its ctx_group's device, inserting
+        transfers at group boundaries.  Works on concrete arrays (eager
+        forward) and under jax tracing (the vjp closure)."""
+        import jax
         low = self._low
 
         def want_dev(node):
@@ -418,18 +454,23 @@ class Executor(object):
         aux_updates = {}
         for node in low.order:
             if node.is_var:
-                src = self.arg_dict.get(node.name) or self.aux_dict.get(node.name)
-                if src is None:
+                if node.name in vals:
+                    values[(id(node), 0)] = vals[node.name]
+                elif node.name in aux_vals:
+                    values[(id(node), 0)] = aux_vals[node.name]
+                else:
                     raise MXNetError("unbound variable %s" % node.name)
-                values[(id(node), 0)] = src.value
                 continue
             tgt = want_dev(node)
             ins = []
             for c, i in node.inputs:
                 v = values[(id(c), i)]
-                if tgt is not None and hasattr(v, "devices") and \
-                        tgt not in v.devices():
-                    v = jax.device_put(v, tgt)
+                if tgt is not None:
+                    if isinstance(v, jax.core.Tracer):
+                        # under the vjp trace: always constrain placement
+                        v = jax.device_put(v, tgt)
+                    elif hasattr(v, "devices") and tgt not in v.devices():
+                        v = jax.device_put(v, tgt)
                 ins.append(v)
             call = node.op.make_callable(node.params, is_train)
             if node.op.needs_rng:
@@ -454,20 +495,7 @@ class Executor(object):
                     child = node.inputs[pos][0]
                     if child.is_var:
                         aux_updates[child.name] = out[n_vis + k]
-        outs = [values[k] for k in low.out_keys]
-        if is_train and self._grad_arg_names():
-            # eager vjp across devices; the pullback is cached for backward
-            gnames = self._grad_arg_names()
-
-            def f(gargs):
-                merged = {n: a.value for n, a in self.arg_dict.items()}
-                merged.update(gargs)
-                o, _ = low.run(merged, self._aux_values(), rng, True)
-                return tuple(o)
-            primals = {n: self.arg_dict[n].value for n in gnames}
-            _, vjp_fn = jax.vjp(f, primals)
-            self._pullback = vjp_fn
-        return outs, aux_updates
+        return [values[k] for k in low.out_keys], aux_updates
 
     # ---------------------------------------------------------------- utility
     def copy_params_from(self, arg_params, aux_params=None,

@@ -523,3 +523,13 @@ class PrefetchingIter(DataIter):
             self._drain()  # unblock producers stuck in q.put, release batches
         except Exception:
             pass
+
+
+def __getattr__(name):
+    """Lazy aliases for iterators that live in mxnet_tpu.image (parity: the
+    reference registers ImageRecordIter in src/io and exposes it via mx.io).
+    Lazy to avoid a circular import (image.py imports this module)."""
+    if name in ("ImageRecordIter", "ImageIter"):
+        from . import image as _image
+        return getattr(_image, name)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

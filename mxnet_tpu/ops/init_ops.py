@@ -76,3 +76,36 @@ def _zeros_like(data):
 @register("ones_like")
 def _ones_like(data):
     return jnp.ones_like(data)
+
+
+def _state_init_infer(attrs, in_shapes):
+    shape = parse_tuple(attrs.get("shape", ()))
+    like = in_shapes[0]
+    ba = int(attrs.get("batch_axis", 0))
+    out = None
+    if like is not None:
+        out = tuple(like[ba] if s == 0 else int(s) for s in shape)
+    return list(in_shapes), [out], None
+
+
+def _state_init_type(attrs, in_dtypes):
+    dt = attrs.get("dtype")
+    out = dt if dt is not None else (in_dtypes[0] or _np.float32)
+    return list(in_dtypes), [out], []
+
+
+@register("_state_init", arg_names=("data",),
+          attr_types={"shape": parse_tuple, "batch_axis": int,
+                      "value": float, "dtype": parse_dtype},
+          defaults={"batch_axis": 0, "value": 0.0},
+          infer_shape=_state_init_infer, infer_type=_state_init_type,
+          hidden=True)
+def _state_init(data, shape=(), batch_axis=0, value=0.0, dtype=None):
+    """Constant fill whose unknown (0) dims take the batch size of `data` at
+    `batch_axis` — the TPU-native resolution of MXNet's 0-means-unknown
+    state shapes (reference: nnvm InferShape treats 0 as a wildcard;
+    rnn_cell.state_shape = (0, num_hidden)).  Static under jit: shapes come
+    from the traced aval, so XLA sees a constant."""
+    b = data.shape[batch_axis]
+    out = tuple(b if s == 0 else int(s) for s in shape)
+    return jnp.full(out, value, dtype if dtype is not None else data.dtype)

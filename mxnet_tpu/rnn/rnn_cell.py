@@ -54,6 +54,37 @@ class BaseRNNCell(object):
     def __call__(self, inputs, states):
         raise NotImplementedError()
 
+    def _resolve_states(self, states, like, batch_axis=0):
+        """Replace begin-state init symbols carrying MXNet's 0=unknown batch
+        dim with `_state_init(like)` nodes that take the batch size from the
+        live input (TPU-native stand-in for nnvm InferShape's 0-wildcard
+        resolution).  Handles the constant init funcs (zeros/ones/full) and
+        forwards their dtype; other 0-batch producers raise."""
+        fill_of = {"_zeros": 0.0, "_ones": 1.0}
+        out = []
+        for s in states:
+            node = s._outputs[0][0] if isinstance(s, symbol.Symbol) else None
+            if node is not None and not node.is_var \
+                    and 0 in tuple(node.params.get("shape") or ()):
+                if node.op.name in fill_of or node.op.name == "_full":
+                    value = node.params.get("value") \
+                        if node.op.name == "_full" \
+                        else fill_of[node.op.name]
+                    kwargs = {"shape": node.params["shape"],
+                              "batch_axis": batch_axis,
+                              "value": float(value or 0.0)}
+                    if node.params.get("dtype") is not None:
+                        kwargs["dtype"] = node.params["dtype"]
+                    out.append(symbol.create("_state_init", like, **kwargs))
+                else:
+                    raise MXNetError(
+                        "begin_state func %r with unknown (0) batch dim is "
+                        "not supported; use zeros/ones/full or pass a "
+                        "fully-shaped state" % node.op.name)
+            else:
+                out.append(s)
+        return out
+
     @property
     def params(self):
         self._own_params = False
@@ -175,6 +206,7 @@ class RNNCell(BaseRNNCell):
 
     def __call__(self, inputs, states):
         self._counter += 1
+        states = self._resolve_states(states, inputs)
         name = "%st%d_" % (self._prefix, self._counter)
         i2h = symbol.create("FullyConnected", data=inputs, weight=self._iW,
                             bias=self._iB, num_hidden=self._num_hidden,
@@ -212,6 +244,7 @@ class LSTMCell(BaseRNNCell):
 
     def __call__(self, inputs, states):
         self._counter += 1
+        states = self._resolve_states(states, inputs)
         name = "%st%d_" % (self._prefix, self._counter)
         i2h = symbol.create("FullyConnected", data=inputs, weight=self._iW,
                             bias=self._iB, num_hidden=self._num_hidden * 4,
@@ -260,6 +293,7 @@ class GRUCell(BaseRNNCell):
 
     def __call__(self, inputs, states):
         self._counter += 1
+        states = self._resolve_states(states, inputs)
         name = "%st%d_" % (self._prefix, self._counter)
         prev_state_h = states[0]
         i2h = symbol.create("FullyConnected", data=inputs, weight=self._iW,
@@ -368,7 +402,8 @@ class FusedRNNCell(BaseRNNCell):
             inputs = symbol.create("SwapAxis", inputs, dim1=0, dim2=1)
         if begin_state is None:
             begin_state = self.begin_state()
-        states = begin_state
+        # inputs are TNC here: batch is axis 1 of the like-input
+        states = self._resolve_states(begin_state, inputs, batch_axis=1)
         kwargs = {}
         if self._mode == "lstm":
             kwargs["state_cell"] = states[1]
@@ -601,6 +636,9 @@ class ZoneoutCell(ModifierCell):
     def __call__(self, inputs, states):
         cell, p_outputs, p_states = self.base_cell, self.zoneout_outputs, \
             self.zoneout_states
+        # resolve 0-batch begin states HERE too: the where() below mixes the
+        # base cell's (resolved) next_states with our captured old states
+        states = self._resolve_states(states, inputs)
         next_output, next_states = cell(inputs, states)
         mask = lambda p, like: symbol.create(
             "Dropout", symbol.create("ones_like", like), p=p)

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Pack an image list into a RecordIO file (parity: reference
+tools/im2rec.py / im2rec.cc).
+
+Usage:
+    python tools/im2rec.py <prefix> <root> --list ...   # make a .lst
+    python tools/im2rec.py <prefix> <root>              # pack prefix.lst
+
+List format (tab-separated): index  label[...]  relative_path
+Outputs prefix.rec (+ prefix.idx for random access).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def make_list(prefix, root, recursive=True, train_ratio=1.0, shuffle=True,
+              exts=(".jpg", ".jpeg", ".png")):
+    paths = []
+    if recursive:
+        classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        label_of = {c: i for i, c in enumerate(classes)}
+        for c in classes:
+            for dirpath, _, files in os.walk(os.path.join(root, c)):
+                for f in sorted(files):
+                    if os.path.splitext(f)[1].lower() in exts:
+                        rel = os.path.relpath(os.path.join(dirpath, f), root)
+                        paths.append((label_of[c], rel))
+    else:
+        for f in sorted(os.listdir(root)):
+            if os.path.splitext(f)[1].lower() in exts:
+                paths.append((0, f))
+    if shuffle:
+        random.shuffle(paths)
+    n_train = int(len(paths) * train_ratio)
+    with open(prefix + ".lst", "w") as out:
+        for i, (label, rel) in enumerate(paths[:n_train]):
+            out.write("%d\t%f\t%s\n" % (i, label, rel))
+    if train_ratio < 1.0:
+        with open(prefix + "_val.lst", "w") as out:
+            for i, (label, rel) in enumerate(paths[n_train:]):
+                out.write("%d\t%f\t%s\n" % (i, label, rel))
+    return len(paths)
+
+
+def pack(prefix, root, resize=0, quality=95, num_thread=1):
+    from mxnet_tpu import recordio
+    from mxnet_tpu import image as mx_image
+
+    record = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                        "w")
+    count = 0
+    with open(prefix + ".lst") as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            path = os.path.join(root, parts[-1])
+            with open(path, "rb") as imgf:
+                buf = imgf.read()
+            if resize > 0:
+                img = mx_image.imdecode(buf)
+                img = mx_image.resize_short(img, resize)
+                buf = mx_image.imencode(img, quality=quality)
+            label = labels[0] if len(labels) == 1 else labels
+            header = recordio.IRHeader(0, label, idx, 0)
+            record.write_idx(idx, recordio.pack(header, buf))
+            count += 1
+    record.close()
+    return count
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true",
+                    help="make the .lst file instead of packing")
+    ap.add_argument("--recursive", action="store_true", default=True)
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--no-shuffle", action="store_true")
+    ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--quality", type=int, default=95)
+    args = ap.parse_args()
+    if args.list:
+        n = make_list(args.prefix, args.root, args.recursive,
+                      args.train_ratio, not args.no_shuffle)
+        print("wrote %d entries to %s.lst" % (n, args.prefix))
+    else:
+        n = pack(args.prefix, args.root, args.resize, args.quality)
+        print("packed %d records into %s.rec" % (n, args.prefix))
+
+
+if __name__ == "__main__":
+    main()
