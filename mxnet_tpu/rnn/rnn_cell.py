@@ -65,6 +65,7 @@ class BaseRNNCell(object):
         for s in states:
             node = s._outputs[0][0] if isinstance(s, symbol.Symbol) else None
             if node is not None and not node.is_var \
+                    and node.op.name != "_state_init" \
                     and 0 in tuple(node.params.get("shape") or ()):
                 if node.op.name in fill_of or node.op.name == "_full":
                     value = node.params.get("value") \
