@@ -200,19 +200,25 @@ class NDArray(object):
         if not self.writable:
             raise MXNetError("NDArray is not writable")
         jnp = _jnp()
-        if isinstance(value, NDArray):
-            value = value.value
-        elif isinstance(value, (np.ndarray, list, int, float, np.generic)):
-            value = jnp.asarray(value, dtype=self.dtype)
-        if isinstance(key, _pyslice) and key.start is None and key.stop is None:
-            if hasattr(value, "shape") and tuple(value.shape) == self.shape:
-                self._set_value(jnp.asarray(value, self.dtype))
-            else:
-                self._set_value(jnp.broadcast_to(
-                    jnp.asarray(value, self.dtype), self.shape) + 0)
-            return
-        cur = self.value
-        self._set_value(cur.at[key].set(value))
+        # stay on this array's device: converting host values through the
+        # default backend would bounce every assignment off the accelerator
+        with _on_device(self.context):
+            if isinstance(value, NDArray):
+                value = value.value
+            elif isinstance(value, np.ndarray):
+                value = _host_to_device(value, self.dtype, self.context)
+            elif isinstance(value, (list, int, float, np.generic)):
+                value = jnp.asarray(value, dtype=self.dtype)
+            if isinstance(key, _pyslice) and key.start is None \
+                    and key.stop is None:
+                if hasattr(value, "shape") and tuple(value.shape) == self.shape:
+                    self._set_value(jnp.asarray(value, self.dtype))
+                else:
+                    self._set_value(jnp.broadcast_to(
+                        jnp.asarray(value, self.dtype), self.shape) + 0)
+                return
+            cur = self.value
+            self._set_value(cur.at[key].set(value))
 
     # ------------------------------------------------------------- arithmetic
     def __add__(self, other):
@@ -353,10 +359,30 @@ def _wrap(arr, ctx):
     return NDArray(arr, ctx=ctx)
 
 
+def _on_device(ctx):
+    """Pin uncommitted computation to the context's device.
+
+    Imperative ops must run where the context says, not on the process's
+    default backend: under a remote accelerator a ``cpu`` context op would
+    otherwise pay a device round-trip (compile + transfer) per call."""
+    import jax
+    return jax.default_device(ctx.jax_device())
+
+
+def _host_to_device(npv, dtype, ctx):
+    """Cast host-side, then ONE transfer to the context device (no detour
+    through the default backend)."""
+    import jax
+    return jax.device_put(
+        np.ascontiguousarray(np.asarray(npv).astype(dtype, copy=False)),
+        ctx.jax_device())
+
+
 def _invoke(op_name, nds, attrs, ctx=None, out=None):
     arrays = [a.value for a in nds]
-    outs, op = _reg.imperative_invoke(op_name, arrays, attrs)
     ctx = ctx or (nds[0].context if nds else current_context())
+    with _on_device(ctx):
+        outs, op = _reg.imperative_invoke(op_name, arrays, attrs)
     n_vis = op.num_outputs_for(op.normalize_attrs(attrs or {}))
     vis = outs[:n_vis]
     # write aux updates back into trailing aux inputs (BatchNorm moving stats)
@@ -423,7 +449,8 @@ def _creation(op, shape, ctx, dtype, **extra):
     if isinstance(shape, int):
         shape = (shape,)
     attrs = dict(shape=tuple(shape), dtype=_reg.parse_dtype(dtype), **extra)
-    outs, _ = _reg.imperative_invoke(op, [], attrs)
+    with _on_device(ctx):
+        outs, _ = _reg.imperative_invoke(op, [], attrs)
     arr = jax.device_put(outs[0], ctx.jax_device())
     return NDArray(arr, ctx=ctx)
 
@@ -438,19 +465,19 @@ def array(source_array, ctx=None, dtype=None):
     if dtype is None:
         dtype = {np.dtype(np.float64): np.float32,
                  np.dtype(np.int64): np.int32}.get(arr.dtype, arr.dtype)
-    arr = jax.device_put(_jnp().asarray(arr, _reg.parse_dtype(dtype)),
-                         ctx.jax_device())
+    arr = _host_to_device(arr, _reg.parse_dtype(dtype), ctx)
     return NDArray(arr, ctx=ctx)
 
 
 def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=np.float32):
     import jax
     ctx = ctx or current_context()
-    outs, _ = _reg.imperative_invoke(
-        "_arange", [], {"start": float(start),
-                        "stop": None if stop is None else float(stop),
-                        "step": float(step), "repeat": int(repeat),
-                        "dtype": _reg.parse_dtype(dtype)})
+    with _on_device(ctx):
+        outs, _ = _reg.imperative_invoke(
+            "_arange", [], {"start": float(start),
+                            "stop": None if stop is None else float(stop),
+                            "step": float(step), "repeat": int(repeat),
+                            "dtype": _reg.parse_dtype(dtype)})
     return NDArray(jax.device_put(outs[0], ctx.jax_device()), ctx=ctx)
 
 
@@ -586,7 +613,8 @@ def _make_ndarray_function(op):
         if not nds:  # creation-style op
             import jax
             ctx = ctx or current_context()
-            outs, _ = _reg.imperative_invoke(op.name, [], kwargs)
+            with _on_device(ctx):
+                outs, _ = _reg.imperative_invoke(op.name, [], kwargs)
             return NDArray(jax.device_put(outs[0], ctx.jax_device()), ctx=ctx)
         return _invoke(op.name, nds, kwargs, ctx, out=out)
 
@@ -608,6 +636,25 @@ def _init_ndarray_module(target):
             fn = _make_ndarray_function(op)
             seen[id(op)] = fn
         target[name] = fn
+
+
+def maximum(lhs, rhs):
+    """Elementwise max of two arrays or an array and a scalar (parity:
+    reference python/mxnet/ndarray.py maximum)."""
+    if isinstance(lhs, NDArray):
+        return _binary("_maximum", "_maximum_scalar", lhs, rhs)
+    if isinstance(rhs, NDArray):
+        return _binary("_maximum", "_maximum_scalar", rhs, lhs)
+    return np.maximum(lhs, rhs)
+
+
+def minimum(lhs, rhs):
+    """Elementwise min of two arrays or an array and a scalar."""
+    if isinstance(lhs, NDArray):
+        return _binary("_minimum", "_minimum_scalar", lhs, rhs)
+    if isinstance(rhs, NDArray):
+        return _binary("_minimum", "_minimum_scalar", rhs, lhs)
+    return np.minimum(lhs, rhs)
 
 
 # populate module namespace with op functions (e.g. mx.nd.relu, mx.nd.dot)

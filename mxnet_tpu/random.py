@@ -15,11 +15,20 @@ _state = threading.local()
 _DEFAULT_SEED = 0
 
 
+def _host():
+    """Key bookkeeping runs on the host CPU backend: the keys are 8 bytes,
+    and splitting on a remote accelerator would cost a tunnel round-trip per
+    imperative sample op."""
+    import jax
+    return jax.default_device(jax.local_devices(backend="cpu")[0])
+
+
 def _get():
     key = getattr(_state, "key", None)
     if key is None:
         import jax
-        key = jax.random.PRNGKey(_DEFAULT_SEED)
+        with _host():
+            key = jax.random.PRNGKey(_DEFAULT_SEED)
         _state.key = key
     return _state.key
 
@@ -27,13 +36,15 @@ def _get():
 def seed(seed_state):
     """Seed the global generator (parity: mx.random.seed, MXRandomSeed)."""
     import jax
-    _state.key = jax.random.PRNGKey(int(seed_state))
+    with _host():
+        _state.key = jax.random.PRNGKey(int(seed_state))
 
 
 def next_key():
     """Draw a fresh subkey from the global stream."""
     import jax
     key = _get()
-    key, sub = jax.random.split(key)
+    with _host():
+        key, sub = jax.random.split(key)
     _state.key = key
     return sub

@@ -63,8 +63,9 @@ class _FunctionalOptimizer(object):
 
     # ------------------------------------------------------------------ state
     def init_state(self, params):
-        import jax.numpy as jnp
-        zeros = lambda w: jnp.zeros(w.shape, w.dtype)
+        # host-side zeros: one transfer at placement time, no per-shape
+        # accelerator compiles
+        zeros = lambda w: _np.zeros(w.shape, w.dtype)
         state = {}
         for n, w in params.items():
             if self.kind in ("sgd", "ccsgd", "nag"):
@@ -249,7 +250,6 @@ class TrainStep(object):
         optimizer state.  Returns (params, opt_state, aux) pytrees of
         jax.Arrays, placed according to the mesh."""
         import jax
-        import jax.numpy as jnp
         from . import initializer as init_mod
         if initializer is None:
             initializer = init_mod.Xavier(magnitude=2.0)
@@ -263,17 +263,36 @@ class TrainStep(object):
         aux2shape = dict(zip(self.aux_names, aux_shapes))
         _random.seed(seed)
         params = {}
-        for n in self.param_names:
-            arr = nd.zeros(name2shape[n])
-            initializer(init_mod.InitDesc(n), arr)
-            params[n] = arr.value
+        # initialise host-side (cpu context): under a remote accelerator the
+        # per-param imperative ops would otherwise pay a tunnel round-trip
+        # each; the finished tensors move to the devices in one hop below
+        from .context import cpu as _cpu_ctx
+        with _cpu_ctx():
+            for n in self.param_names:
+                arr = nd.zeros(name2shape[n])
+                initializer(init_mod.InitDesc(n), arr)
+                params[n] = arr.value
         aux = {}
         for n in self.aux_names:
-            v = jnp.ones(aux2shape[n], _np.float32) \
+            v = _np.ones(aux2shape[n], _np.float32) \
                 if ("moving_var" in n or "_var" in n) \
-                else jnp.zeros(aux2shape[n], _np.float32)
+                else _np.zeros(aux2shape[n], _np.float32)
             aux[n] = v
         opt_state = self.fopt.init_state(params)
+        if self.mesh is None:
+            # commit everything to the compute device in one hop so the fused
+            # step runs there (host-committed params would drag the whole
+            # computation onto the CPU backend); an explicitly-entered
+            # context (``with mx.tpu(1):``) picks the device, otherwise the
+            # process default accelerator
+            from .context import Context
+            ambient = getattr(Context._default_ctx, "value", None)
+            dev = (ambient.jax_device() if ambient is not None
+                   else jax.devices()[0])
+            params = {n: jax.device_put(v, dev) for n, v in params.items()}
+            opt_state = {n: tuple(jax.device_put(s, dev) for s in st)
+                         for n, st in opt_state.items()}
+            aux = {n: jax.device_put(v, dev) for n, v in aux.items()}
         if self.mesh is not None:
             from jax.sharding import NamedSharding
             rep = NamedSharding(self.mesh, _pspec())

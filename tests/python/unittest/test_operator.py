@@ -463,8 +463,10 @@ def test_regression_outputs():
         out = ex.forward(is_train=True)[0].asnumpy()
         np.testing.assert_allclose(out, fwd(x), rtol=1e-5)
         ex.backward()
+        # reference divides by num_output = label.size/batch
+        # (regression_output-inl.h:70-77); here num_output = 3
         np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
-                                   grad(fwd(x), y) / 1.0, rtol=1e-4,
+                                   grad(fwd(x), y) / 3.0, rtol=1e-4,
                                    atol=1e-5)
 
 
