@@ -205,7 +205,7 @@ class Executor(object):
                 from .symbol import _topo
                 for n in _topo([x for x, _ in symbol._outputs]):
                     if n.is_var and n.name == name:
-                        grp = n.attr.get("ctx_group")
+                        grp = n.attr.get("ctx_group") or n.attr.get("__ctx_group__")
                         if grp and grp in group2ctx:
                             return group2ctx[grp]
             return ctx
@@ -363,6 +363,10 @@ class Executor(object):
             outs, aux_upd = res[0], res[1]
             if monitor:
                 collected = res[2]
+        # actual output devices (group2ctx outputs may live off the bind ctx;
+        # backward() must place cotangents where the pullback residuals are)
+        self._out_devices = [next(iter(v.devices()))
+                             if hasattr(v, "devices") else None for v in outs]
         for ndarr, v in zip(self._output_nds, outs):
             ndarr._set_value(v)
         if is_train:
@@ -384,11 +388,29 @@ class Executor(object):
             return
         if out_grads is None:
             self._check_default_heads()
-            ogs = tuple(_ones_like_val(o) for o in self._output_nds)
+            import jax
+            devs = getattr(self, "_out_devices", None) or \
+                [None] * len(self._output_nds)
+            ogs = tuple(
+                jax.device_put(_ones_like_val(o), dev) if dev is not None
+                else _ones_like_val(o)
+                for o, dev in zip(self._output_nds, devs))
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
-            ogs = tuple(g.value for g in out_grads)
+            # cotangents must live on their output's device (group2ctx
+            # model parallelism: outputs may sit on different devices)
+            import jax
+            devs = getattr(self, "_out_devices", None) or \
+                [None] * len(out_grads)
+            ogs = []
+            for g, dev in zip(out_grads, devs):
+                gv = g.value
+                if dev is not None and hasattr(gv, "devices") \
+                        and dev not in gv.devices():
+                    gv = jax.device_put(gv, dev)
+                ogs.append(gv)
+            ogs = tuple(ogs)
         if self._pullback is None:
             raise MXNetError(
                 "backward() requires a preceding forward(is_train=True)")
@@ -445,7 +467,7 @@ class Executor(object):
         low = self._low
 
         def want_dev(node):
-            grp = node.attr.get("ctx_group")
+            grp = node.attr.get("ctx_group") or node.attr.get("__ctx_group__")
             if grp and grp in self._group2ctx:
                 return self._group2ctx[grp].jax_device()
             return None

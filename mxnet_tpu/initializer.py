@@ -44,6 +44,16 @@ class Initializer(object):
             raise TypeError("name must be string")
         if not isinstance(arr, nd.NDArray):
             raise TypeError("arr must be NDArray")
+        # variable-attached initializer wins (parity: reference
+        # initializer.py __call__ reading desc.attrs['__init__'], as set by
+        # Variable(init=...) — e.g. LSTMCell forget-gate bias)
+        init_attr = getattr(name, "attrs", None)
+        init_attr = (init_attr or {}).get("__init__", "")
+        if init_attr:
+            klass, kwargs = json.loads(init_attr)
+            _INITIALIZER_REGISTRY[klass.lower()](**kwargs)._init_weight(
+                name, arr)
+            return
         if name.startswith("upsampling"):
             self._init_bilinear(name, arr)
         elif name.endswith("bias"):
@@ -270,8 +280,16 @@ class LSTMBias(Initializer):
         arr[:] = 0.0
         if arr.shape[0] % 4 == 0:
             num_hidden = arr.shape[0] // 4
-            v = arr.asnumpy()
+            v = arr.asnumpy().copy()
             v[num_hidden:2 * num_hidden] = self.forget_bias
             arr[:] = v
 
     _init_weight = _init_bias
+
+
+# registry of initializer classes by lowercase name, used by the
+# Variable(init=...) '__init__' attr dispatch and Load/Mixed dumps parity
+_INITIALIZER_REGISTRY = {
+    k.lower(): v for k, v in list(globals().items())
+    if isinstance(v, type) and issubclass(v, Initializer)
+}

@@ -51,7 +51,7 @@ class DataParallelExecutorGroup(object):
         self.inputs_need_grad = inputs_need_grad
         self.logger = logger
         self.fixed_param_names = set(fixed_param_names or [])
-        self.state_names = set(state_names or [])
+        self.state_names = list(state_names or [])
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
         self.output_names = symbol.list_outputs()
@@ -99,6 +99,8 @@ class DataParallelExecutorGroup(object):
         self.batch_size = self.data_shapes[0].shape[batch_axis]
         self.slices = _split_input_slice(self.batch_size, self.workload)
         grad_req = self._grad_req_dict()
+        # capture before reset: reshape() shares with self's old executors
+        shared_execs = shared_group.execs if shared_group is not None else None
         self.execs = []
         for i, ctx in enumerate(self.contexts):
             sl = self.slices[i]
@@ -110,8 +112,8 @@ class DataParallelExecutorGroup(object):
                 for l in self.label_shapes:
                     shapes[l.name] = (nrows,) + tuple(l.shape[1:])
             shared_exec = None
-            if shared_group is not None:
-                shared_exec = shared_group.execs[i]
+            if shared_execs is not None:
+                shared_exec = shared_execs[i]
             ex = self.symbol.simple_bind(ctx=ctx, grad_req=grad_req,
                                          shared_exec=shared_exec, **shapes)
             self.execs.append(ex)
@@ -202,6 +204,38 @@ class DataParallelExecutorGroup(object):
             return [g[0] if len(g) == 1 else nd.concatenate(g, axis=0)
                     for g in grads]
         return grads
+
+    def get_states(self, merge_multi_context=True):
+        """Recurrent-state arrays (parity: executor_group get_states)."""
+        states = [[ex.arg_dict[name] for ex in self.execs]
+                  for name in self.state_names]
+        if merge_multi_context:
+            return [s[0] if len(s) == 1 else nd.concatenate(s, axis=0)
+                    for s in states]
+        return states
+
+    def set_states(self, states=None, value=None):
+        """Assign recurrent-state inputs: per-device structure, a merged
+        full-batch array (sliced across executors like _load_general), or a
+        scalar fill (parity: executor_group set_states)."""
+        if states is not None:
+            assert value is None
+            for name, blocks in zip(self.state_names, states):
+                if not isinstance(blocks, (list, tuple)):
+                    blocks = [blocks]
+                if len(blocks) == 1 and len(self.execs) > 1:
+                    # merged array: slice the batch across executors
+                    merged = blocks[0]
+                    for ex, sl in zip(self.execs, self.slices):
+                        ex.arg_dict[name][:] = merged[sl.start:sl.stop]
+                else:
+                    for ex, block in zip(self.execs, blocks):
+                        ex.arg_dict[name][:] = block
+        else:
+            assert value is not None
+            for name in self.state_names:
+                for ex in self.execs:
+                    ex.arg_dict[name][:] = value
 
     def update_metric(self, eval_metric, labels):
         """(parity: executor_group.update_metric)"""

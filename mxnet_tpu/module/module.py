@@ -39,7 +39,7 @@ class Module(BaseModule):
         data_names = list(data_names) if data_names is not None else []
         label_names = list(label_names) if label_names is not None else []
         arg_names = symbol.list_arguments()
-        input_names = data_names + label_names
+        input_names = data_names + label_names + list(state_names or [])
         self._param_names = [x for x in arg_names if x not in input_names]
         self._fixed_param_names = list(fixed_param_names or [])
         self._aux_names = symbol.list_auxiliary_states()
@@ -234,6 +234,18 @@ class Module(BaseModule):
         if shared_module is not None and shared_module.optimizer_initialized:
             self.borrow_optimizer(shared_module)
 
+    def reshape(self, data_shapes, label_shapes=None):
+        """Re-bind for new batch shapes, keeping parameters (parity:
+        reference module.py Module.reshape)."""
+        assert self.binded
+        self._data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                             for x in data_shapes]
+        self._label_shapes = None if label_shapes is None or \
+            not label_shapes else \
+            [x if isinstance(x, DataDesc) else DataDesc(*x)
+             for x in label_shapes]
+        self._exec_group.reshape(self._data_shapes, self._label_shapes)
+
     # -------------------------------------------------------------- optimizer
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
@@ -334,6 +346,16 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and \
             self.inputs_need_grad
         return self._exec_group.get_input_grads(merge_multi_context)
+
+    def get_states(self, merge_multi_context=True):
+        """Recurrent-state outputs (parity: reference module.py get_states)."""
+        assert self.binded and self.params_initialized
+        return self._exec_group.get_states(merge_multi_context)
+
+    def set_states(self, states=None, value=None):
+        """Set recurrent-state inputs (parity: reference module.py set_states)."""
+        assert self.binded and self.params_initialized
+        self._exec_group.set_states(states, value)
 
     def update_metric(self, eval_metric, labels):
         self._exec_group.update_metric(eval_metric, labels)

@@ -16,9 +16,12 @@ from .registry import register, parse_dtype, parse_int
 
 def _same_shape_infer(n_in):
     def infer(attrs, in_shapes):
-        known = next((s for s in in_shapes if s is not None), None)
-        ins = [s if s is not None else known for s in in_shapes]
-        return ins, [known], None
+        from .registry import shape_unify
+        unified = None
+        for s in in_shapes:
+            unified = shape_unify(unified, s)
+        ins = [unified for _ in in_shapes]
+        return ins, [unified], None
     return infer
 
 

@@ -27,10 +27,11 @@ def _fc_args(attrs):
 
 
 def _fc_infer(attrs, in_shapes):
+    from .registry import shape_is_complete
     nh = int(attrs.get("num_hidden"))
     data = in_shapes[0]
     ins = list(in_shapes)
-    if data is not None:
+    if data is not None and shape_is_complete(data[1:]):
         flat = int(_np.prod(data[1:]))
         ins[1] = (nh, flat)
     if len(ins) > 2:
@@ -39,10 +40,27 @@ def _fc_infer(attrs, in_shapes):
     return ins, [out], None
 
 
+def _fc_infer_backward(attrs, out_shapes, in_shapes):
+    """Deduce a 2-D data shape from output + weight (nnvm InferShape backward
+    half — resolves RNN begin-state batch dims through shared h2h weights)."""
+    out = out_shapes[0]
+    weight = in_shapes[1] if len(in_shapes) > 1 else None
+    ins = [None] * len(in_shapes)
+    if out is None:
+        return ins
+    data = in_shapes[0]
+    if weight is not None and (data is None or
+                               (len(data) == 2 and 0 in data)):
+        ins[0] = (out[0], weight[1])
+    elif data is not None and data[0] == 0 and out[0] != 0:
+        ins[0] = (out[0],) + tuple(data[1:])
+    return ins
+
+
 @register("FullyConnected", arg_names=_fc_args,
           attr_types={"num_hidden": parse_int, "no_bias": parse_bool},
           defaults={"no_bias": False},
-          infer_shape=_fc_infer)
+          infer_shape=_fc_infer, infer_shape_backward=_fc_infer_backward)
 def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False):
     """y = x·Wᵀ + b (parity: fully_connected-inl.h; MXU matmul)."""
     x = data.reshape((data.shape[0], -1))
@@ -83,6 +101,7 @@ def _lrelu_infer(attrs, in_shapes):
                       "lower_bound": parse_float, "upper_bound": parse_float},
           defaults={"act_type": "leaky", "slope": 0.25, "lower_bound": 0.125,
                     "upper_bound": 0.334},
+          input_init_attrs={"gamma": '["Constant", {"value": 0.25}]'},
           infer_shape=_lrelu_infer, needs_rng=True, train_aware=True)
 def _leaky_relu(data, gamma=None, rng=None, is_train=False, act_type="leaky",
                 slope=0.25, lower_bound=0.125, upper_bound=0.334):

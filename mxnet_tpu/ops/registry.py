@@ -111,6 +111,7 @@ class OpDef(object):
 
     def __init__(self, name, fn, arg_names=("data",), aux_names=(), num_outputs=1,
                  attr_types=None, defaults=None, infer_shape=None, infer_type=None,
+                 infer_shape_backward=None, input_init_attrs=None,
                  needs_rng=False, train_aware=False, key_var_num_args=None,
                  aliases=(), hidden=False, doc=None, is_loss=False):
         self.name = name
@@ -124,6 +125,11 @@ class OpDef(object):
         self.defaults = dict(defaults or {})
         self._infer_shape = infer_shape
         self._infer_type = infer_type
+        self.infer_shape_backward = infer_shape_backward
+        # {arg_name: '__init__' json} applied to auto-created input variables
+        # (parity: nnvm FSetInputVariableAttrs, e.g. LeakyReLU gamma=0.25,
+        # reference src/operator/leaky_relu.cc:43-44)
+        self.input_init_attrs = dict(input_init_attrs or {})
         self.needs_rng = needs_rng
         self.train_aware = train_aware
         self.key_var_num_args = key_var_num_args
@@ -208,6 +214,31 @@ def eval_shape_infer(op, attrs, in_shapes, in_dtypes):
     n_out = op.num_outputs_for(attrs)
     return (list(in_shapes), shapes[:n_out],
             shapes[n_out:n_out + op.num_aux] if op.num_aux else None)
+
+
+def shape_unify(a, b):
+    """Merge two partially-known shapes. ``None`` = fully unknown; a 0 entry
+    is an unknown dim (MXNet's wildcard, e.g. RNN begin-state batch).  Returns
+    the most specific shape, or None if both unknown; raises on conflict."""
+    if a is None:
+        return None if b is None else tuple(b)
+    if b is None:
+        return tuple(a)
+    if len(a) != len(b):
+        raise ValueError("shape rank mismatch %r vs %r" % (a, b))
+    out = []
+    for x, y in zip(a, b):
+        if x == 0:
+            out.append(y)
+        elif y == 0 or x == y:
+            out.append(x)
+        else:
+            raise ValueError("shape conflict %r vs %r" % (a, b))
+    return tuple(out)
+
+
+def shape_is_complete(s):
+    return s is not None and 0 not in tuple(s)
 
 
 def register(name, **kwargs):

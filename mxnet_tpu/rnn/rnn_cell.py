@@ -496,12 +496,37 @@ class SequentialRNNCell(BaseRNNCell):
         next_states = []
         p = 0
         for cell in self._cells:
+            if isinstance(cell, BidirectionalCell):
+                raise MXNetError("Bidirectional cannot be stepped; "
+                                 "use unroll")
             n = len(cell.state_shape)
             state = states[p:p + n]
             p += n
             inputs, state = cell(inputs, state)
             next_states.append(state)
         return inputs, sum(next_states, [])
+
+    def unroll(self, length, inputs=None, begin_state=None, input_prefix="",
+               layout="NTC", merge_outputs=False):
+        """Unroll cell-by-cell over the whole sequence so stacked
+        Bidirectional/Fused cells work (parity: reference rnn_cell.py
+        SequentialRNNCell.unroll)."""
+        self.reset()
+        if begin_state is None:
+            begin_state = self.begin_state()
+        num_cells = len(self._cells)
+        p = 0
+        next_states = []
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_shape)
+            cell_states = begin_state[p:p + n]
+            p += n
+            inputs, states = cell.unroll(
+                length, inputs=inputs, begin_state=cell_states,
+                input_prefix=input_prefix, layout=layout,
+                merge_outputs=False if i < num_cells - 1 else merge_outputs)
+            next_states.extend(states)
+        return inputs, next_states
 
 
 class BidirectionalCell(BaseRNNCell):

@@ -205,7 +205,8 @@ class Symbol(object):
     def infer_shape(self, *args, **kwargs):
         arg_shapes, out_shapes, aux_shapes = self._infer_shape_impl(
             *args, **kwargs)
-        if arg_shapes is not None and any(s is None for s in arg_shapes):
+        if arg_shapes is not None and any(
+                s is None or 0 in s for s in arg_shapes):
             return None, None, None
         return arg_shapes, out_shapes, aux_shapes
 
@@ -366,12 +367,23 @@ def Group(symbols):
     return Symbol(outs)
 
 
+# op-call kwargs lifted into __k__ node attrs and inherited by auto-created
+# variable inputs (parity: kHiddenKeys, reference src/c_api/c_api_symbolic.cc:20-25
+# + nnvm compose attr inheritance — this is how ``FullyConnected(lr_mult=0)``
+# freezes the layer's auto-created weight/bias)
+_HIDDEN_KEYS = ("ctx_group", "lr_mult", "wd_mult", "force_mirroring",
+                "mirror_stage")
+
+
 def create(op_name, *args, **kwargs):
     """Create a node applying ``op_name`` (the generic symbol constructor)."""
     op = _reg.get_op(op_name)
     name = kwargs.pop("name", None)
     attr = kwargs.pop("attr", None)
-    attr = AttrScope.current().get(attr)
+    attr = dict(AttrScope.current().get(attr))
+    for k in _HIDDEN_KEYS:
+        if k in kwargs:
+            attr["__%s__" % k] = str(kwargs.pop(k))
     # split symbol inputs from op params
     sym_kwargs = {}
     params = {}
@@ -408,7 +420,11 @@ def create(op_name, *args, **kwargs):
         else:
             s = next(pos_iter, None)
         if s is None:
-            s = Variable("%s_%s" % (name, an))
+            inherited = {k: v for k, v in attr.items()
+                         if k.strip("_") in _HIDDEN_KEYS}
+            if an in op.input_init_attrs:
+                inherited.setdefault("__init__", op.input_init_attrs[an])
+            s = Variable("%s_%s" % (name, an), attr=inherited or None)
         if len(s._outputs) != 1:
             raise MXNetError("cannot feed grouped symbol to input %s" % an)
         inputs.append(s._outputs[0])
@@ -467,8 +483,13 @@ def _run_shape_inference(symbol, known):
     """Fixpoint bidirectional shape propagation over the DAG.
 
     Returns (var_shapes: name->shape, out_shapes: (node_id, idx)->shape).
-    Parity: nnvm InferShape pass + per-op bidirectional rules.
+    Parity: nnvm InferShape pass + per-op bidirectional rules.  A 0 dim is
+    MXNet's unknown-dim wildcard (e.g. RNN begin-state batch): wildcards
+    propagate forward and are narrowed by unification wherever a sibling path
+    knows the dim; ops with an ``infer_shape_backward`` rule additionally
+    deduce input shapes from known outputs (nnvm InferShape's backward half).
     """
+    from .ops.registry import shape_unify
     out_nodes = [n for n, _ in symbol._outputs]
     order = _topo(out_nodes)
     var_shapes = dict(known)
@@ -478,38 +499,79 @@ def _run_shape_inference(symbol, known):
             from .ops.registry import parse_tuple
             var_shapes[n.name] = parse_tuple(n.attr["__shape__"])
     out_shapes = {}
-    for _ in range(3):
+
+    def merge(cur, new):
+        """Unify; returns (merged, improved?). Conflicts keep cur."""
+        if new is None:
+            return cur, False
+        new = tuple(int(x) for x in new)
+        try:
+            m = shape_unify(cur, new)
+        except ValueError:
+            raise MXNetError(
+                "shape inference conflict: %r vs %r" % (cur, new))
+        return m, m != cur
+
+    for _ in range(10):
         changed = False
+
+        def write_input(child, ci, s):
+            nonlocal changed
+            if s is None:
+                return
+            if child.is_var:
+                m, imp = merge(var_shapes.get(child.name), s)
+                if imp:
+                    var_shapes[child.name] = m
+                    changed = True
+            m, imp = merge(out_shapes.get((id(child), ci)), s)
+            if imp:
+                out_shapes[(id(child), ci)] = m
+                changed = True
+
         for node in order:
             if node.is_var:
-                s = var_shapes.get(node.name)
-                if out_shapes.get((id(node), 0)) != s and s is not None:
-                    out_shapes[(id(node), 0)] = tuple(s)
+                m, imp = merge(out_shapes.get((id(node), 0)),
+                               var_shapes.get(node.name))
+                if imp:
+                    out_shapes[(id(node), 0)] = m
+                    changed = True
+                # narrowed by a consumer: reflect back into var_shapes
+                m2, imp2 = merge(var_shapes.get(node.name),
+                                 out_shapes.get((id(node), 0)))
+                if imp2:
+                    var_shapes[node.name] = m2
                     changed = True
                 continue
             in_shapes = [out_shapes.get((id(c), i)) for c, i in node.inputs]
             try:
                 new_in, new_out, _aux = node.op.infer_shape(node.params,
                                                             in_shapes)
+            except MXNetError:
+                raise
             except Exception:
-                continue
-            # write back newly deduced input shapes to variable children
-            for (child, ci), s in zip(node.inputs, new_in):
-                if s is None:
-                    continue
-                s = tuple(int(x) for x in s)
-                if child.is_var and var_shapes.get(child.name) is None:
-                    var_shapes[child.name] = s
-                    changed = True
-                if out_shapes.get((id(child), ci)) is None:
-                    out_shapes[(id(child), ci)] = s
-                    changed = True
+                new_in, new_out = None, None
+            if new_in is not None:
+                for (child, ci), s in zip(node.inputs, new_in):
+                    write_input(child, ci, s)
             for i, s in enumerate(new_out or []):
                 if s is not None:
-                    s = tuple(int(x) for x in s)
-                    if out_shapes.get((id(node), i)) != s:
-                        out_shapes[(id(node), i)] = s
+                    m, imp = merge(out_shapes.get((id(node), i)), s)
+                    if imp:
+                        out_shapes[(id(node), i)] = m
                         changed = True
+            # backward half: deduce inputs from known outputs
+            bwd = getattr(node.op, "infer_shape_backward", None)
+            if bwd is not None:
+                cur_out = [out_shapes.get((id(node), i))
+                           for i in range(node.num_outputs())]
+                cur_in = [out_shapes.get((id(c), i)) for c, i in node.inputs]
+                try:
+                    back_in = bwd(node.params, cur_out, cur_in)
+                except Exception:
+                    back_in = None
+                for (child, ci), s in zip(node.inputs, back_in or ()):
+                    write_input(child, ci, s)
         if not changed:
             break
     return var_shapes, out_shapes

@@ -267,10 +267,11 @@ class TrainStep(object):
         # per-param imperative ops would otherwise pay a tunnel round-trip
         # each; the finished tensors move to the devices in one hop below
         from .context import cpu as _cpu_ctx
+        attrs = self.symbol.attr_dict()
         with _cpu_ctx():
             for n in self.param_names:
                 arr = nd.zeros(name2shape[n])
-                initializer(init_mod.InitDesc(n), arr)
+                initializer(init_mod.InitDesc(n, attrs.get(n)), arr)
                 params[n] = arr.value
         aux = {}
         for n in self.aux_names:

@@ -1,0 +1,128 @@
+"""Initializer tests (parity model: reference tests/python/unittest/
+test_init.py — default/variable/aux init — plus statistical checks)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_default_init():
+    """(parity: test_init.py test_default_init)"""
+    data = mx.sym.Variable("data")
+    sym = mx.sym.LeakyReLU(data=data, act_type="prelu")
+    mod = mx.Module(sym, label_names=None, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (10, 10))])
+    mod.init_params()
+    for k, v in mod.get_params()[0].items():
+        assert (v.asnumpy() == 0.25).all(), k
+
+
+def test_variable_init():
+    """Variable(init=...) overrides the global initializer
+    (parity: test_init.py test_variable_init)."""
+    data = mx.sym.Variable("data")
+    gamma = mx.sym.Variable("gamma", init=mx.initializer.One())
+    sym = mx.sym.LeakyReLU(data=data, gamma=gamma, act_type="prelu")
+    mod = mx.Module(sym, label_names=None, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (10, 10))])
+    mod.init_params()
+    for k, v in mod.get_params()[0].items():
+        assert (v.asnumpy() == 1).all(), k
+
+
+def test_aux_init():
+    """BatchNorm aux states: moving_mean=0, moving_var=1
+    (parity: test_init.py test_aux_init)."""
+    data = mx.sym.Variable("data")
+    sym = mx.sym.BatchNorm(data=data, name="bn")
+    mod = mx.Module(sym, label_names=None, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (10, 10, 3, 3))])
+    mod.init_params()
+    assert (mod.get_params()[1]["bn_moving_var"].asnumpy() == 1).all()
+    assert (mod.get_params()[1]["bn_moving_mean"].asnumpy() == 0).all()
+
+
+def test_uniform_range():
+    mx.random.seed(0)
+    arr = mx.nd.zeros((200, 50))
+    mx.initializer.Uniform(scale=0.3)(
+        mx.initializer.InitDesc("fc_weight"), arr)
+    v = arr.asnumpy()
+    assert v.min() >= -0.3 and v.max() <= 0.3
+    assert abs(v.mean()) < 0.02
+
+
+def test_normal_sigma():
+    mx.random.seed(0)
+    arr = mx.nd.zeros((200, 50))
+    mx.initializer.Normal(sigma=2.0)(
+        mx.initializer.InitDesc("fc_weight"), arr)
+    v = arr.asnumpy()
+    assert abs(v.std() - 2.0) < 0.1
+
+
+def test_xavier_scale():
+    mx.random.seed(0)
+    arr = mx.nd.zeros((64, 64))
+    mx.initializer.Xavier(rnd_type="uniform", factor_type="avg",
+                          magnitude=3)(
+        mx.initializer.InitDesc("fc_weight"), arr)
+    v = arr.asnumpy()
+    bound = np.sqrt(3.0 / 64)
+    assert v.min() >= -bound - 1e-6 and v.max() <= bound + 1e-6
+
+
+def test_orthogonal():
+    arr = mx.nd.zeros((32, 32))
+    mx.initializer.Orthogonal(scale=1.0)(
+        mx.initializer.InitDesc("fc_weight"), arr)
+    v = arr.asnumpy()
+    np.testing.assert_allclose(v @ v.T, np.eye(32), atol=1e-4)
+
+
+def test_bias_gamma_beta_defaults():
+    init = mx.initializer.Xavier()
+    for name, expect in [("fc_bias", 0.0), ("bn_gamma", 1.0),
+                         ("bn_beta", 0.0)]:
+        arr = mx.nd.ones((7,)) * 9
+        init(mx.initializer.InitDesc(name), arr)
+        assert (arr.asnumpy() == expect).all(), name
+
+
+def test_constant_and_load():
+    arr = mx.nd.zeros((3, 3))
+    mx.initializer.Constant(0.5)(mx.initializer.InitDesc("w_weight"), arr)
+    assert (arr.asnumpy() == 0.5).all()
+
+    src = {"arg:fc_weight": mx.nd.ones((2, 2)) * 4}
+    load = mx.initializer.Load(src,
+                               default_init=mx.initializer.Zero())
+    a = mx.nd.zeros((2, 2))
+    load("fc_weight", a)
+    assert (a.asnumpy() == 4).all()
+    b = mx.nd.ones((2, 2))
+    load("other_weight", b)
+    assert (b.asnumpy() == 0).all()
+
+
+def test_mixed():
+    """Pattern routing; note each routed initializer still dispatches by
+    suffix (bias->_init_bias=0), matching reference Mixed semantics."""
+    init = mx.initializer.Mixed([".*bias", ".*"],
+                                [mx.initializer.Zero(),
+                                 mx.initializer.Constant(2.0)])
+    a = mx.nd.ones((4,))
+    init("fc_bias", a)
+    assert (a.asnumpy() == 0).all()
+    b = mx.nd.zeros((4,))
+    init("fc_weight", b)
+    assert (b.asnumpy() == 2).all()
+
+
+def test_initializer_dumps_roundtrip():
+    init = mx.initializer.Xavier(rnd_type="gaussian", factor_type="in",
+                                 magnitude=2)
+    s = init.dumps()
+    import json
+    klass, kwargs = json.loads(s)
+    assert klass == "xavier"
+    assert kwargs["magnitude"] == 2

@@ -1,0 +1,182 @@
+"""Data-iterator tests (parity model: reference
+tests/python/unittest/test_io.py test_NDArrayIter + test_recordio semantics;
+dataset-download iters replaced by synthetic data)."""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+RS = np.random.RandomState
+
+
+def test_ndarray_iter_pad():
+    """(parity: reference test_io.py test_NDArrayIter — exact batch content
+    accounting with pad last_batch_handle)."""
+    datas = np.ones([1000, 2, 2])
+    labels = np.ones([1000, 1])
+    for i in range(1000):
+        datas[i] = i / 100
+        labels[i] = i / 100
+    dataiter = mx.io.NDArrayIter(datas, labels, 128, True,
+                                 last_batch_handle="pad")
+    batchidx = 0
+    for batch in dataiter:
+        batchidx += 1
+    assert batchidx == 8
+    dataiter = mx.io.NDArrayIter(datas, labels, 128, False,
+                                 last_batch_handle="pad")
+    batchidx = 0
+    labelcount = [0] * 10
+    for batch in dataiter:
+        label = batch.label[0].asnumpy().flatten()
+        assert (batch.data[0].asnumpy()[:, 0, 0] == label).all()
+        for i in range(label.shape[0]):
+            labelcount[int(label[i])] += 1
+    for i in range(10):
+        if i == 0:
+            # pad wraps to the beginning
+            assert labelcount[i] == 124
+        else:
+            assert labelcount[i] == 100
+
+
+def test_ndarray_iter_discard():
+    x = np.arange(23).reshape(23, 1).astype(np.float32)
+    it = mx.io.NDArrayIter(x, None, batch_size=5,
+                           last_batch_handle="discard")
+    n = sum(1 for _ in it)
+    assert n == 4
+
+
+def test_ndarray_iter_roll_over():
+    x = np.arange(7).reshape(7, 1).astype(np.float32)
+    it = mx.io.NDArrayIter(x, None, batch_size=3,
+                           last_batch_handle="roll_over")
+    epoch1 = [b.data[0].asnumpy().copy() for b in it]
+    it.reset()
+    epoch2 = [b.data[0].asnumpy().copy() for b in it]
+    assert len(epoch1) >= 2 and len(epoch2) >= 2
+
+
+def test_ndarray_iter_shuffle_deterministic():
+    x = np.arange(40).reshape(40, 1).astype(np.float32)
+    np.random.seed(7)
+    it1 = mx.io.NDArrayIter(x, None, batch_size=10, shuffle=True)
+    order1 = np.concatenate([b.data[0].asnumpy().ravel() for b in it1])
+    # all elements present exactly once
+    assert sorted(order1.tolist()) == list(range(40))
+    assert not np.array_equal(order1, np.arange(40))  # actually shuffled
+
+
+def test_ndarray_iter_dict_data():
+    data = {"a": np.zeros((12, 2), np.float32),
+            "b": np.ones((12, 3), np.float32)}
+    label = {"softmax_label": np.arange(12, dtype=np.float32)}
+    it = mx.io.NDArrayIter(data, label, batch_size=4)
+    names = [d.name for d in it.provide_data]
+    assert sorted(names) == ["a", "b"]
+    batch = next(iter(it))
+    assert batch.data[0].shape in ((4, 2), (4, 3))
+
+
+def test_csv_iter(tmp_path):
+    path = str(tmp_path / "data.csv")
+    lpath = str(tmp_path / "label.csv")
+    data = RS(0).rand(20, 6).astype(np.float32)
+    label = RS(1).randint(0, 3, (20, 1)).astype(np.float32)
+    np.savetxt(path, data, delimiter=",")
+    np.savetxt(lpath, label, delimiter=",")
+    it = mx.io.CSVIter(data_csv=path, data_shape=(6,), label_csv=lpath,
+                       batch_size=5)
+    got = []
+    for b in it:
+        got.append(b.data[0].asnumpy())
+    got = np.concatenate(got)
+    np.testing.assert_allclose(got, data, rtol=1e-5)
+
+
+def test_resize_iter():
+    x = np.arange(30).reshape(30, 1).astype(np.float32)
+    base = mx.io.NDArrayIter(x, None, batch_size=5)
+    it = mx.io.ResizeIter(base, size=2)
+    assert sum(1 for _ in it) == 2
+    it.reset()
+    assert sum(1 for _ in it) == 2
+
+
+def test_prefetching_iter():
+    """PrefetchingIter yields identical batches to its base iterator."""
+    x = RS(0).rand(40, 3).astype(np.float32)
+    y = RS(1).randint(0, 2, 40).astype(np.float32)
+    base1 = mx.io.NDArrayIter(x, y, batch_size=8)
+    base2 = mx.io.NDArrayIter(x, y, batch_size=8)
+    pre = mx.io.PrefetchingIter(base2)
+    got = [(b.data[0].asnumpy().copy(), b.label[0].asnumpy().copy())
+           for b in pre]
+    want = [(b.data[0].asnumpy().copy(), b.label[0].asnumpy().copy())
+            for b in base1]
+    assert len(got) == len(want)
+    for (gd, gl), (wd, wl) in zip(got, want):
+        np.testing.assert_array_equal(gd, wd)
+        np.testing.assert_array_equal(gl, wl)
+    # second epoch works too
+    pre.reset()
+    assert sum(1 for _ in pre) == len(want)
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = mx.recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(("record%d" % i).encode())
+    w.close()
+    r = mx.recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert r.read() == ("record%d" % i).encode()
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx = str(tmp_path / "test.idx")
+    w = mx.recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(10):
+        w.write_idx(i, ("rec%d" % i).encode())
+    w.close()
+    r = mx.recordio.MXIndexedRecordIO(idx, path, "r")
+    for i in [3, 7, 0, 9]:
+        assert r.read_idx(i) == ("rec%d" % i).encode()
+    r.close()
+
+
+def test_recordio_pack_unpack():
+    header = mx.recordio.IRHeader(0, 3.0, 7, 0)
+    s = mx.recordio.pack(header, b"payload")
+    h2, content = mx.recordio.unpack(s)
+    assert h2.label == 3.0 and h2.id == 7
+    assert content == b"payload"
+
+
+def test_mnist_iter_synthetic(tmp_path):
+    """MNISTIter reads idx-format files (synthetic, no download)."""
+    import gzip
+    import struct
+    img_path = str(tmp_path / "img.gz")
+    lbl_path = str(tmp_path / "lbl.gz")
+    n = 30
+    imgs = RS(0).randint(0, 255, (n, 28, 28)).astype(np.uint8)
+    lbls = RS(1).randint(0, 10, n).astype(np.uint8)
+    with gzip.open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(lbls.tobytes())
+    it = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=10,
+                         shuffle=False)
+    batches = list(it)
+    assert len(batches) == 3
+    got = batches[0].label[0].asnumpy().astype(int)
+    np.testing.assert_array_equal(got, lbls[:10])

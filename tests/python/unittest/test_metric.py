@@ -1,0 +1,122 @@
+"""Metric math vs numpy (the reference has no dedicated metric suite at
+v0.9.4 — metric behavior is asserted through fit logs; here each metric is
+unit-checked directly against handwritten formulas)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+RS = np.random.RandomState
+
+
+def nd(x):
+    return mx.nd.array(np.asarray(x, np.float32))
+
+
+def test_accuracy():
+    m = mx.metric.Accuracy()
+    preds = nd([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    labels = nd([1, 0, 0])
+    m.update([labels], [preds])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3) < 1e-6
+
+
+def test_topk_accuracy():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    preds = nd([[0.1, 0.2, 0.7], [0.5, 0.4, 0.1], [0.1, 0.6, 0.3]])
+    labels = nd([1, 2, 0])
+    m.update([labels], [preds])
+    _, acc = m.get()
+    # top2 sets: {2,1} hit, {0,1} miss, {1,2} miss -> 1/3
+    assert abs(acc - 1.0 / 3) < 1e-6
+
+
+def test_f1():
+    m = mx.metric.F1()
+    preds = nd([[0.7, 0.3], [0.2, 0.8], [0.6, 0.4], [0.1, 0.9]])
+    labels = nd([0, 1, 1, 1])
+    m.update([labels], [preds])
+    _, f1 = m.get()
+    # predictions: 0,1,0,1 ; tp=2 fp=0 fn=1 -> p=1, r=2/3, f1=0.8
+    assert abs(f1 - 0.8) < 1e-6
+
+
+def test_mae_mse_rmse():
+    preds = nd([[1.0], [2.0], [3.0]])
+    labels = nd([[2.0], [2.0], [5.0]])
+    m = mx.metric.MAE()
+    m.update([labels], [preds])
+    assert abs(m.get()[1] - (1 + 0 + 2) / 3.0) < 1e-6
+    m = mx.metric.MSE()
+    m.update([labels], [preds])
+    assert abs(m.get()[1] - (1 + 0 + 4) / 3.0) < 1e-6
+    m = mx.metric.RMSE()
+    m.update([labels], [preds])
+    assert abs(m.get()[1] - np.sqrt(5 / 3.0)) < 1e-5
+
+
+def test_cross_entropy():
+    preds = np.array([[0.2, 0.8], [0.9, 0.1]], np.float32)
+    labels = np.array([1, 0], np.float32)
+    m = mx.metric.CrossEntropy()
+    m.update([nd(labels)], [nd(preds)])
+    want = -(np.log(0.8) + np.log(0.9)) / 2
+    assert abs(m.get()[1] - want) < 1e-5
+
+
+def test_perplexity():
+    preds = np.array([[0.25, 0.75], [0.5, 0.5]], np.float32)
+    labels = np.array([1, 0], np.float32)
+    m = mx.metric.Perplexity(ignore_label=None)
+    m.update([nd(labels)], [nd(preds)])
+    want = np.exp(-(np.log(0.75) + np.log(0.5)) / 2)
+    assert abs(m.get()[1] - want) < 1e-4
+
+
+def test_composite():
+    m = mx.metric.CompositeEvalMetric(metrics=[mx.metric.Accuracy(),
+                                               mx.metric.MSE()])
+    preds = nd([[0.1, 0.9], [0.8, 0.2]])
+    labels = nd([1, 1])
+    m.update([labels], [preds])
+    names, vals = m.get()
+    assert len(names) == 2 and len(vals) == 2
+
+
+def test_custom_metric():
+    def mymetric(label, pred):
+        return float(np.abs(label - pred.argmax(axis=1)).mean())
+    m = mx.metric.CustomMetric(mymetric, name="mymetric")
+    preds = nd([[0.1, 0.9], [0.8, 0.2]])
+    labels = nd([0, 0])
+    m.update([labels], [preds])
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_np_metric():
+    m = mx.metric.np(lambda label, pred: float((label == 0).mean()))
+    preds = nd([[1.0], [1.0]])
+    labels = nd([0, 1])
+    m.update([labels], [preds])
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_create_by_name():
+    for name in ["acc", "accuracy", "mse", "mae", "rmse", "ce"]:
+        m = mx.metric.create(name)
+        assert isinstance(m, mx.metric.EvalMetric), name
+    with pytest.raises(Exception):
+        mx.metric.create("nope_metric")
+
+
+def test_reset_and_running_average():
+    m = mx.metric.Accuracy()
+    m.update([nd([1])], [nd([[0.0, 1.0]])])
+    assert m.get()[1] == 1.0
+    m.update([nd([0])], [nd([[0.0, 1.0]])])
+    assert m.get()[1] == 0.5
+    m.reset()
+    assert np.isnan(m.get()[1]) or m.get()[1] != m.get()[1] or \
+        m.num_inst == 0

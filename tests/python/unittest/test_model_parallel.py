@@ -1,0 +1,89 @@
+"""Model-parallel group2ctx tests (parity model: reference
+tests/python/unittest/test_model_parallel.py — a chain split across two
+devices with AttrScope(ctx_group=...) matches the single-device result, for
+outputs AND gradients)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+RS = np.random.RandomState
+
+
+def _net():
+    with mx.AttrScope(ctx_group="dev1"):
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+        act1 = mx.sym.Activation(fc1, act_type="tanh")
+    with mx.AttrScope(ctx_group="dev2"):
+        fc2 = mx.sym.FullyConnected(act1, num_hidden=8, name="fc2")
+        out = mx.sym.Activation(fc2, act_type="tanh")
+    return out
+
+
+def test_chain_two_devices():
+    """(parity: test_model_parallel.py:12-54)"""
+    net = _net()
+    shape = (4, 10)
+    rng = RS(0)
+    arr_np = {}
+    arg_names = net.list_arguments()
+    _, arg_shapes = None, None
+    arg_shapes, _, _ = net.infer_shape(data=shape)
+    for name, s in zip(arg_names, arg_shapes):
+        arr_np[name] = rng.uniform(-1, 1, s).astype(np.float32)
+
+    def run(group2ctx):
+        args = {k: mx.nd.array(v) for k, v in arr_np.items()}
+        grads = {k: mx.nd.zeros(v.shape) for k, v in arr_np.items()}
+        ex = net.bind(mx.cpu(), args, args_grad=grads,
+                      group2ctx=group2ctx)
+        out = ex.forward(is_train=True)[0].asnumpy().copy()
+        ex.backward([mx.nd.ones((4, 8))])
+        g = {k: v.asnumpy().copy() for k, v in grads.items()}
+        return out, g
+
+    out1, g1 = run(None)
+    out2, g2 = run({"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    np.testing.assert_allclose(out1, out2, rtol=1e-5)
+    for k in g1:
+        np.testing.assert_allclose(g1[k], g2[k], rtol=1e-4, atol=1e-6)
+
+
+def test_group2ctx_training():
+    """A group2ctx-bound module trains end to end."""
+    with mx.AttrScope(ctx_group="dev1"):
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+        act = mx.sym.Activation(fc1, act_type="relu")
+    with mx.AttrScope(ctx_group="dev2"):
+        fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+        net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    rng = RS(0)
+    x = rng.randn(40, 10).astype(np.float32)
+    centers = rng.randn(4, 10).astype(np.float32) * 2
+    y = rng.randint(0, 4, 40).astype(np.float32)
+    x = x + centers[y.astype(int)]
+
+    args = {"data": mx.nd.array(x[:20]),
+            "softmax_label": mx.nd.array(y[:20])}
+    arg_shapes, _, _ = net.infer_shape(data=(20, 10), softmax_label=(20,))
+    names = net.list_arguments()
+    grads = {}
+    for n, s in zip(names, arg_shapes):
+        if n in ("data", "softmax_label"):
+            continue
+        mx.random.seed(hash(n) % 100)
+        args[n] = mx.nd.uniform(low=-0.1, high=0.1, shape=s)
+        grads[n] = mx.nd.zeros(s)
+    ex = net.bind(mx.cpu(), args, args_grad=grads,
+                  group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    losses = []
+    for step in range(30):
+        out = ex.forward(is_train=True)[0].asnumpy()
+        p = np.clip(out[np.arange(20), y[:20].astype(int)], 1e-9, 1)
+        losses.append(-np.log(p).mean())
+        ex.backward()
+        for n, g in grads.items():
+            args[n][:] = args[n].asnumpy() - 0.5 / 20 * g.asnumpy()
+    assert losses[-1] < losses[0] * 0.7, losses

@@ -1,0 +1,218 @@
+"""Optimizer math vs handwritten numpy references (parity model: reference
+tests/python/unittest/test_optimizer.py — each optimizer checked step-by-step
+against an independent numpy implementation of the reference update rules)."""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt_mod
+
+RS = np.random.RandomState
+
+
+def run_steps(opt, w0, grads, index=0):
+    """Drive opt.update() through the NDArray path; return final weight."""
+    weight = mx.nd.array(w0)
+    state = opt.create_state(index, weight)
+    for g in grads:
+        opt.update(index, weight, mx.nd.array(g), state)
+    return weight.asnumpy(), state
+
+
+def _prep(g, w, rescale, clip, wd):
+    g = g * rescale
+    if clip is not None:
+        g = np.clip(g, -clip, clip)
+    return g + wd * w
+
+
+def test_sgd_no_momentum():
+    w0 = RS(0).rand(4, 3).astype(np.float32)
+    grads = [RS(i + 1).rand(4, 3).astype(np.float32) for i in range(3)]
+    opt = opt_mod.SGD(learning_rate=0.1, wd=0.01, rescale_grad=0.5)
+    got, _ = run_steps(opt, w0, grads)
+    w = w0.copy()
+    for g in grads:
+        w = w - 0.1 * _prep(g, w, 0.5, None, 0.01)
+    np.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+def test_sgd_momentum_clip():
+    w0 = RS(0).rand(5).astype(np.float32)
+    grads = [RS(i + 1).randn(5).astype(np.float32) * 3 for i in range(4)]
+    opt = opt_mod.SGD(learning_rate=0.05, momentum=0.9, wd=0.001,
+                      clip_gradient=0.5)
+    got, _ = run_steps(opt, w0, grads)
+    w, mom = w0.copy(), np.zeros(5, np.float32)
+    for g in grads:
+        gp = _prep(g, w, 1.0, 0.5, 0.001)
+        mom = 0.9 * mom - 0.05 * gp
+        w = w + mom
+    np.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+def test_nag():
+    w0 = RS(0).rand(6).astype(np.float32)
+    grads = [RS(i + 7).randn(6).astype(np.float32) for i in range(3)]
+    opt = opt_mod.NAG(learning_rate=0.1, momentum=0.9, wd=0.01)
+    got, _ = run_steps(opt, w0, grads)
+    w, mom = w0.copy(), np.zeros(6, np.float32)
+    for g in grads:
+        gp = g + 0.01 * w
+        mom = 0.9 * mom + gp
+        w = w - 0.1 * (gp + 0.9 * mom)
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-6)
+
+
+def test_adam():
+    w0 = RS(0).rand(4, 2).astype(np.float32)
+    grads = [RS(i + 3).randn(4, 2).astype(np.float32) for i in range(5)]
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    opt = opt_mod.Adam(learning_rate=0.01, beta1=b1, beta2=b2, epsilon=eps,
+                       wd=0.02)
+    got, _ = run_steps(opt, w0, grads)
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t, g in enumerate(grads, 1):
+        lr = 0.01 * math.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        gp = g + 0.02 * w
+        m = b1 * m + (1 - b1) * gp
+        v = b2 * v + (1 - b2) * gp * gp
+        w = w - lr * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-6)
+
+
+def test_rmsprop_tieleman():
+    w0 = RS(1).rand(8).astype(np.float32)
+    grads = [RS(i + 11).randn(8).astype(np.float32) for i in range(4)]
+    opt = opt_mod.RMSProp(learning_rate=0.01, gamma1=0.95, epsilon=1e-8)
+    got, _ = run_steps(opt, w0, grads)
+    w, n = w0.copy(), np.zeros(8, np.float32)
+    for g in grads:
+        n = 0.05 * g * g + 0.95 * n
+        w = w - 0.01 * g / np.sqrt(n + 1e-8)
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-6)
+
+
+def test_rmsprop_centered():
+    w0 = RS(1).rand(8).astype(np.float32)
+    grads = [RS(i + 21).randn(8).astype(np.float32) for i in range(4)]
+    opt = opt_mod.RMSProp(learning_rate=0.01, gamma1=0.95, gamma2=0.9,
+                          epsilon=1e-8, centered=True)
+    got, _ = run_steps(opt, w0, grads)
+    w = w0.copy()
+    n = np.zeros(8, np.float32)
+    gbar = np.zeros(8, np.float32)
+    delta = np.zeros(8, np.float32)
+    for g in grads:
+        n = 0.05 * g * g + 0.95 * n
+        gbar = 0.05 * g + 0.95 * gbar
+        delta = 0.9 * delta - 0.01 * g / np.sqrt(n - gbar * gbar + 1e-8)
+        w = w + delta
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-6)
+
+
+def test_adagrad():
+    w0 = RS(2).rand(5).astype(np.float32)
+    grads = [RS(i + 31).randn(5).astype(np.float32) for i in range(4)]
+    opt = opt_mod.AdaGrad(learning_rate=0.1, eps=1e-7)
+    got, _ = run_steps(opt, w0, grads)
+    w, h = w0.copy(), np.zeros(5, np.float32)
+    for g in grads:
+        h = h + g * g
+        w = w - 0.1 * g / np.sqrt(h + 1e-7)
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-6)
+
+
+def test_adadelta():
+    w0 = RS(3).rand(5).astype(np.float32)
+    grads = [RS(i + 41).randn(5).astype(np.float32) for i in range(4)]
+    opt = opt_mod.AdaDelta(rho=0.9, epsilon=1e-5)
+    got, _ = run_steps(opt, w0, grads)
+    w = w0.copy()
+    acc_g = np.zeros(5, np.float32)
+    acc_d = np.zeros(5, np.float32)
+    for g in grads:
+        acc_g = 0.9 * acc_g + 0.1 * g * g
+        cur = np.sqrt(acc_d + 1e-5) / np.sqrt(acc_g + 1e-5) * g
+        acc_d = 0.9 * acc_d + 0.1 * cur * cur
+        w = w - cur
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-6)
+
+
+def test_lr_wd_mult():
+    """lr_mult/wd_mult from __lr_mult__/__wd_mult__ symbol attrs, inherited by
+    auto-created weights (parity: reference test_optimizer.py test_lr_wd_mult;
+    attr lifting per src/c_api/c_api_symbolic.cc kHiddenKeys)."""
+    data = mx.sym.Variable("data")
+    bias = mx.sym.Variable("fc1_bias", lr_mult=1.0)
+    fc1 = mx.sym.FullyConnected(data=data, bias=bias, name="fc1",
+                                num_hidden=10, lr_mult=0)
+    fc2 = mx.sym.FullyConnected(data=fc1, name="fc2", num_hidden=10,
+                                wd_mult=0.5)
+    mod = mx.Module(fc2, label_names=None, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (5, 10))])
+    mod.init_params(initializer=mx.initializer.Uniform(1.0))
+    mod.init_optimizer(optimizer_params={"learning_rate": 1.0})
+    args1, _ = mod.get_params()
+    args1 = {k: v.asnumpy() for k, v in args1.items()}
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(RS(0).uniform(-1, 1, (5, 10)))], label=None)
+    mod.forward(batch, is_train=True)
+    mod.backward(mod.get_outputs())
+    mod.update()
+    args2, _ = mod.get_params()
+    args2 = {k: v.asnumpy() for k, v in args2.items()}
+    assert mod._optimizer.lr_mult == {"fc1_bias": 1.0, "fc1_weight": 0.0}
+    assert mod._optimizer.wd_mult == {"fc2_bias": 0.5, "fc2_weight": 0.5,
+                                      "fc1_bias": 0.0}
+    np.testing.assert_allclose(args1["fc1_weight"], args2["fc1_weight"],
+                               atol=1e-10)
+    assert np.abs(args1["fc1_bias"] - args2["fc1_bias"]).max() > 1e-1
+    assert np.abs(args1["fc2_weight"] - args2["fc2_weight"]).max() > 1e-1
+
+
+def test_updater_states_serialization():
+    """Updater keeps per-key states and round-trips via get/set_states
+    (parity: optimizer.py Updater + module save/load_optimizer_states)."""
+    opt = opt_mod.SGD(learning_rate=0.1, momentum=0.9)
+    updater = opt_mod.get_updater(opt) if hasattr(opt_mod, "get_updater") \
+        else opt_mod.Updater(opt)
+    w = mx.nd.array(RS(0).rand(3))
+    g = mx.nd.array(RS(1).rand(3))
+    updater(0, g, w)
+    updater(0, g, w)
+    blob = updater.get_states() if hasattr(updater, "get_states") else None
+    if blob is not None:
+        opt2 = opt_mod.SGD(learning_rate=0.1, momentum=0.9)
+        up2 = opt_mod.Updater(opt2)
+        up2.set_states(blob)
+        w2 = w.copyto(mx.cpu())
+        updater(0, g, w)
+        up2(0, g, w2)
+        np.testing.assert_allclose(w.asnumpy(), w2.asnumpy(), rtol=1e-6)
+
+
+def test_lr_scheduler_factor():
+    sch = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    sch.base_lr = 1.0
+    assert sch(1) == 1.0
+    lr4 = sch(4)
+    assert lr4 < 1.0
+    sch2 = mx.lr_scheduler.MultiFactorScheduler(step=[2, 4], factor=0.1)
+    sch2.base_lr = 1.0
+    assert sch2(1) == 1.0
+    assert abs(sch2(3) - 0.1) < 1e-12
+    assert abs(sch2(5) - 0.01) < 1e-12
+
+
+def test_create_by_name():
+    for name in ["sgd", "adam", "rmsprop", "adagrad", "adadelta", "nag",
+                 "sgld", "dcasgd", "ccsgd", "test"]:
+        o = opt_mod.create(name)
+        assert isinstance(o, opt_mod.Optimizer), name
+    with pytest.raises(mx.base.MXNetError):
+        opt_mod.create("no_such_optimizer")

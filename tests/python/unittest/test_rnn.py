@@ -1,0 +1,252 @@
+"""RNN cell toolkit tests (parity model: reference
+tests/python/unittest/test_rnn.py — cell params/outputs/shape checks + unfuse
+— plus numeric recurrence checks vs numpy and fused-vs-unfused forward
+parity, which the reference only runs on GPU)."""
+import numpy as np
+from numpy.testing import assert_allclose
+
+import mxnet_tpu as mx
+
+RS = np.random.RandomState
+
+
+def test_rnn():
+    cell = mx.rnn.RNNCell(100, prefix="rnn_")
+    inputs = [mx.sym.Variable("rnn_t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = mx.sym.Group(outputs)
+    assert sorted(cell.params._params.keys()) == \
+        ["rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias", "rnn_i2h_weight"]
+    _, outs, _ = outputs.infer_shape(rnn_t0_data=(10, 50),
+                                     rnn_t1_data=(10, 50),
+                                     rnn_t2_data=(10, 50))
+    assert outs == [(10, 100), (10, 100), (10, 100)]
+
+
+def test_lstm():
+    cell = mx.rnn.LSTMCell(100, prefix="rnn_", forget_bias=1.0)
+    inputs = [mx.sym.Variable("rnn_t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = mx.sym.Group(outputs)
+    assert sorted(cell.params._params.keys()) == \
+        ["rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias", "rnn_i2h_weight"]
+    _, outs, _ = outputs.infer_shape(rnn_t0_data=(10, 50),
+                                     rnn_t1_data=(10, 50),
+                                     rnn_t2_data=(10, 50))
+    assert outs == [(10, 100), (10, 100), (10, 100)]
+
+
+def test_lstm_forget_bias():
+    forget_bias = 2.0
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(100, forget_bias=forget_bias, prefix="l0_"))
+    stack.add(mx.rnn.LSTMCell(100, forget_bias=forget_bias, prefix="l1_"))
+
+    dshape = (32, 1, 200)
+    data = mx.sym.Variable("data")
+    sym, _ = stack.unroll(1, data, merge_outputs=True)
+    mod = mx.Module(sym, label_names=None, context=mx.cpu(0))
+    mod.bind(data_shapes=[("data", dshape)], label_shapes=None)
+    mod.init_params()
+
+    bias_argument = next(x for x in sym.list_arguments()
+                         if x.endswith("i2h_bias"))
+    expected_bias = np.hstack([np.zeros((100,)),
+                               forget_bias * np.ones(100,),
+                               np.zeros((2 * 100,))])
+    assert_allclose(mod.get_params()[0][bias_argument].asnumpy(),
+                    expected_bias)
+
+
+def test_gru():
+    cell = mx.rnn.GRUCell(100, prefix="rnn_")
+    inputs = [mx.sym.Variable("rnn_t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = mx.sym.Group(outputs)
+    assert sorted(cell.params._params.keys()) == \
+        ["rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias", "rnn_i2h_weight"]
+    _, outs, _ = outputs.infer_shape(rnn_t0_data=(10, 50),
+                                     rnn_t1_data=(10, 50),
+                                     rnn_t2_data=(10, 50))
+    assert outs == [(10, 100), (10, 100), (10, 100)]
+
+
+def test_stack():
+    cell = mx.rnn.SequentialRNNCell()
+    for i in range(5):
+        cell.add(mx.rnn.LSTMCell(100, prefix="rnn_stack%d_" % i))
+    inputs = [mx.sym.Variable("rnn_t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = mx.sym.Group(outputs)
+    keys = sorted(cell.params._params.keys())
+    for i in range(5):
+        for part in ["h2h_weight", "h2h_bias", "i2h_weight", "i2h_bias"]:
+            assert "rnn_stack%d_%s" % (i, part) in keys
+    _, outs, _ = outputs.infer_shape(rnn_t0_data=(10, 50),
+                                     rnn_t1_data=(10, 50),
+                                     rnn_t2_data=(10, 50))
+    assert outs == [(10, 100), (10, 100), (10, 100)]
+
+
+def test_bidirectional():
+    cell = mx.rnn.BidirectionalCell(
+        mx.rnn.LSTMCell(100, prefix="rnn_l0_"),
+        mx.rnn.LSTMCell(100, prefix="rnn_r0_"),
+        output_prefix="rnn_bi_")
+    inputs = [mx.sym.Variable("rnn_t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = mx.sym.Group(outputs)
+    _, outs, _ = outputs.infer_shape(rnn_t0_data=(10, 50),
+                                     rnn_t1_data=(10, 50),
+                                     rnn_t2_data=(10, 50))
+    assert outs == [(10, 200), (10, 200), (10, 200)]
+
+
+def test_unfuse():
+    cell = mx.rnn.FusedRNNCell(100, num_layers=3, mode="lstm",
+                               prefix="test_", bidirectional=True,
+                               dropout=0.5)
+    cell = cell.unfuse()
+    inputs = [mx.sym.Variable("rnn_t%d_data" % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = mx.sym.Group(outputs)
+    _, outs, _ = outputs.infer_shape(rnn_t0_data=(10, 50),
+                                     rnn_t1_data=(10, 50),
+                                     rnn_t2_data=(10, 50))
+    assert outs == [(10, 200), (10, 200), (10, 200)]
+
+
+def _np_rnn_tanh(x, h, iw, ib, hw, hb):
+    return np.tanh(x @ iw.T + ib + h @ hw.T + hb)
+
+
+def test_rnncell_numeric():
+    """RNNCell forward matches the handwritten recurrence."""
+    nh, ni, batch, T = 6, 4, 3, 4
+    cell = mx.rnn.RNNCell(nh, prefix="rnn_")
+    inputs = [mx.sym.Variable("t%d_data" % i) for i in range(T)]
+    outputs, _ = cell.unroll(T, inputs)
+    net = mx.sym.Group(outputs)
+
+    rng = RS(0)
+    xs = [rng.randn(batch, ni).astype(np.float32) for _ in range(T)]
+    iw = rng.randn(nh, ni).astype(np.float32) * 0.5
+    ib = rng.randn(nh).astype(np.float32) * 0.1
+    hw = rng.randn(nh, nh).astype(np.float32) * 0.5
+    hb = rng.randn(nh).astype(np.float32) * 0.1
+    args = {"t%d_data" % i: mx.nd.array(x) for i, x in enumerate(xs)}
+    args.update({"rnn_i2h_weight": mx.nd.array(iw),
+                 "rnn_i2h_bias": mx.nd.array(ib),
+                 "rnn_h2h_weight": mx.nd.array(hw),
+                 "rnn_h2h_bias": mx.nd.array(hb)})
+    ex = net.bind(mx.cpu(), args)
+    outs = [o.asnumpy() for o in ex.forward()]
+
+    h = np.zeros((batch, nh), np.float32)
+    for t in range(T):
+        h = _np_rnn_tanh(xs[t], h, iw, ib, hw, hb)
+        assert_allclose(outs[t], h, rtol=1e-4, atol=1e-5)
+
+
+def test_lstmcell_numeric():
+    """LSTMCell forward matches the handwritten i,f,g,o recurrence."""
+    nh, ni, batch, T = 5, 3, 2, 3
+    cell = mx.rnn.LSTMCell(nh, prefix="lstm_")
+    inputs = [mx.sym.Variable("t%d_data" % i) for i in range(T)]
+    outputs, _ = cell.unroll(T, inputs)
+    net = mx.sym.Group(outputs)
+
+    rng = RS(1)
+    xs = [rng.randn(batch, ni).astype(np.float32) for _ in range(T)]
+    iw = rng.randn(4 * nh, ni).astype(np.float32) * 0.5
+    ib = rng.randn(4 * nh).astype(np.float32) * 0.1
+    hw = rng.randn(4 * nh, nh).astype(np.float32) * 0.5
+    hb = rng.randn(4 * nh).astype(np.float32) * 0.1
+    args = {"t%d_data" % i: mx.nd.array(x) for i, x in enumerate(xs)}
+    args.update({"lstm_i2h_weight": mx.nd.array(iw),
+                 "lstm_i2h_bias": mx.nd.array(ib),
+                 "lstm_h2h_weight": mx.nd.array(hw),
+                 "lstm_h2h_bias": mx.nd.array(hb)})
+    ex = net.bind(mx.cpu(), args)
+    outs = [o.asnumpy() for o in ex.forward()]
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    h = np.zeros((batch, nh), np.float32)
+    c = np.zeros((batch, nh), np.float32)
+    for t in range(T):
+        gates = xs[t] @ iw.T + ib + h @ hw.T + hb
+        i, f, g, o = np.split(gates, 4, axis=1)
+        c = sig(f) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        assert_allclose(outs[t], h, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_vs_unfused_forward():
+    """FusedRNNCell (lax.scan RNN op) matches the unfused stack numerically
+    when fed the same packed weights (parity model: the reference's GPU-only
+    test_rnn.py check_rnn_consistency)."""
+    nh, ni, batch, T, layers = 4, 3, 2, 5, 2
+    fused = mx.rnn.FusedRNNCell(nh, num_layers=layers, mode="lstm",
+                                prefix="f_", get_next_state=False)
+    fused._input_size_hint = ni
+    data = mx.sym.Variable("data")
+    fsym, _ = fused.unroll(T, data, layout="NTC", merge_outputs=True)
+
+    unfused = fused.unfuse()
+    usym_list, _ = unfused.unroll(T, mx.sym.Variable("data"), layout="NTC",
+                                  merge_outputs=True)
+    usym = usym_list
+
+    rng = RS(2)
+    x = rng.randn(batch, T, ni).astype(np.float32) * 0.5
+
+    # random packed parameter vector, then unpack for the unfused net
+    arg_shapes, _, _ = fsym.infer_shape(data=(batch, T, ni))
+    shapes = dict(zip(fsym.list_arguments(), arg_shapes))
+    pvec = rng.randn(*shapes["f_parameters"]).astype(np.float32) * 0.3
+    fargs = {"data": mx.nd.array(x),
+             "f_parameters": mx.nd.array(pvec)}
+    fex = fsym.bind(mx.cpu(), fargs)
+    fout = fex.forward()[0].asnumpy()
+
+    unpacked = fused.unpack_weights({"f_parameters": mx.nd.array(pvec)})
+    uargs = {"data": mx.nd.array(x)}
+    for k, v in unpacked.items():
+        uargs[k] = v
+    uex = usym.bind(mx.cpu(), uargs)
+    uout = uex.forward()[0].asnumpy()
+
+    assert fout.shape == uout.shape == (batch, T, nh)
+    assert_allclose(fout, uout, rtol=1e-4, atol=1e-5)
+
+
+def test_zoneout_residual_dropout_shapes():
+    for wrap in ["zoneout", "residual", "dropout"]:
+        base = mx.rnn.RNNCell(10, prefix="rnn_")
+        if wrap == "zoneout":
+            cell = mx.rnn.ZoneoutCell(base, zoneout_outputs=0.3,
+                                      zoneout_states=0.3)
+        elif wrap == "residual":
+            cell = mx.rnn.ResidualCell(base)
+        else:
+            cell = mx.rnn.DropoutCell(0.5)
+        inputs = [mx.sym.Variable("t%d_data" % i) for i in range(3)]
+        outputs, _ = cell.unroll(3, inputs)
+        outputs = mx.sym.Group(outputs)
+        _, outs, _ = outputs.infer_shape(t0_data=(4, 10), t1_data=(4, 10),
+                                         t2_data=(4, 10))
+        assert outs == [(4, 10)] * 3, wrap
+
+
+def test_bucket_sentence_iter():
+    """BucketSentenceIter groups by length buckets (parity: rnn/io.py)."""
+    sentences = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [1, 2], [3, 4, 5, 6]]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=1,
+                                   buckets=[3, 5], invalid_label=0)
+    seen = 0
+    for batch in it:
+        assert batch.data[0].shape[1] in (3, 5)
+        seen += 1
+    assert seen == len(sentences)
