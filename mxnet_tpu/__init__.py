@@ -40,6 +40,7 @@ from . import visualization
 from . import visualization as viz
 from . import recordio
 from . import profiler
+from . import engine
 from . import rnn
 from . import test_utils
 

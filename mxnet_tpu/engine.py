@@ -1,0 +1,63 @@
+"""Execution-engine selection (parity: reference src/engine/engine.cc:13-50 +
+the MXNET_ENGINE_TYPE debug affordance, SURVEY.md §5.2).
+
+The reference ships three engines (ThreadedEnginePerDevice, ThreadedEnginePooled,
+NaiveEngine) selected by ``MXNET_ENGINE_TYPE``; swapping to the synchronous
+NaiveEngine is its standard way to bisect async-scheduling bugs.  TPU-natively
+the async dependency scheduler IS JAX/XLA async dispatch (futures + stream
+ordering), so the engine swap maps to:
+
+- ``ThreadedEnginePerDevice`` (default): normal async dispatch — op calls
+  return futures, transfers overlap compute.
+- ``NaiveEngine``: synchronous debugging mode — every imperative op and every
+  executor forward/backward blocks until the result is materialised, so
+  exceptions surface at the op that raised them (XLA async errors otherwise
+  surface at the *next* blocking read, like the reference's async engine).
+
+``MXNET_ENGINE_NOJIT=1`` additionally disables XLA jit for imperative dispatch
+(ops run op-by-op through the interpreter) — the analogue of the reference's
+per-op NaiveEngine execution for kernel-level bisection.
+"""
+from __future__ import annotations
+
+from .base import MXNetError, get_env
+
+__all__ = ["engine_type", "set_engine_type", "is_naive", "maybe_wait",
+           "wait_all"]
+
+_VALID = ("ThreadedEnginePerDevice", "ThreadedEngine", "NaiveEngine")
+_state = {"type": None}
+
+
+def engine_type():
+    """Current engine name (env MXNET_ENGINE_TYPE, parity: engine.cc:14)."""
+    if _state["type"] is None:
+        t = get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+        if t not in _VALID:
+            raise MXNetError("unknown MXNET_ENGINE_TYPE %s" % t)
+        _state["type"] = t
+    return _state["type"]
+
+
+def set_engine_type(t):
+    if t not in _VALID:
+        raise MXNetError("unknown engine type %s" % t)
+    _state["type"] = t
+
+
+def is_naive():
+    return engine_type() == "NaiveEngine"
+
+
+def maybe_wait(arrays):
+    """Block on results under NaiveEngine (sync debugging), no-op otherwise."""
+    if is_naive():
+        import jax
+        jax.block_until_ready(arrays)
+    return arrays
+
+
+def wait_all():
+    """Engine::WaitForAll — drain every pending async computation."""
+    from . import ndarray as _nd
+    _nd.waitall()

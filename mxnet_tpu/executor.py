@@ -269,7 +269,14 @@ class Executor(object):
         """kind: 'fwd_test' | 'fwd_train' (+ '_mon' suffix = monitor collect);
         'grad' | 'grad_mon' = the differentiated forward used under jax.vjp."""
         import jax
-        fn = self._jit_cache.get(kind)
+        # the sequence-parallel mesh is baked into traced programs (the
+        # attention op lowers to shard_map over it), so it must key the cache:
+        # toggling set_sequence_mesh would otherwise reuse stale lowerings
+        from .parallel import mesh as mesh_mod
+        seq_mesh, seq_axis = mesh_mod.sequence_mesh()
+        cache_key = (kind,
+                     None if seq_mesh is None else (id(seq_mesh), seq_axis))
+        fn = self._jit_cache.get(cache_key)
         if fn is not None:
             return fn
         low = self._low
@@ -293,8 +300,19 @@ class Executor(object):
                 outs, aux_upd = res[0], res[1]
                 coll = res[2] if collect else {}
                 return tuple(outs), (aux_upd, coll)
+            from .base import get_env
+            if get_env("MXNET_BACKWARD_DO_MIRROR", "0") == "1":
+                # gradient mirroring -> rematerialisation: drop (some)
+                # forward activations and recompute them in the pullback
+                # (parity: reference graph_executor.cc:205-218 mirror pass;
+                # TPU-natively this is jax.checkpoint trading FLOPs for HBM)
+                policy = None
+                if get_env("MXNET_BACKWARD_MIRROR_POLICY", "") == "dots":
+                    policy = \
+                        jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                f = jax.checkpoint(f, policy=policy)
             fn = jax.jit(f)
-        self._jit_cache[kind] = fn
+        self._jit_cache[cache_key] = fn
         return fn
 
     def _check_default_heads(self):
@@ -321,16 +339,45 @@ class Executor(object):
                 stacklevel=3)
         self._warned_default_heads = True
 
+    @staticmethod
+    def _mesh_replicate(nds):
+        """With a sequence-parallel mesh active the jitted graph contains a
+        shard_map over that mesh, so every input must live on the mesh:
+        replicate single-device-committed values (attention shards them).
+        The replicated array is written back into the NDArray, so steady-state
+        steps pay no re-broadcast (device_put is a no-op once resident)."""
+        from .parallel import mesh as mesh_mod
+        mesh, _ = mesh_mod.sequence_mesh()
+        if mesh is None:
+            return {n: a.value for n, a in nds.items()}
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        rep = NamedSharding(mesh, PartitionSpec())
+        out = {}
+        for n, a in nds.items():
+            v = jax.device_put(a.value, rep)
+            if a._base is None:
+                a._data = v  # commit: later forwards skip the broadcast
+            out[n] = v
+        return out
+
     def _arg_values(self):
-        return {n: a.value for n, a in self.arg_dict.items()}
+        return self._mesh_replicate(dict(self.arg_dict))
 
     def _aux_values(self):
-        return {n: a.value for n, a in self.aux_dict.items()}
+        return self._mesh_replicate(dict(self.aux_dict))
 
     def forward(self, is_train=False, **kwargs):
         """Run forward (parity: Executor::Forward).  With is_train=True the fused
         forward+backward computation runs (one XLA program for the whole step);
         gradients are cached for the subsequent backward() call."""
+        from . import profiler as _profiler
+        with _profiler.Scope("executor.forward[%s]"
+                             % ("train" if is_train else "test"),
+                             "symbolic"):
+            return self._forward_impl(is_train, **kwargs)
+
+    def _forward_impl(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             if k not in self.arg_dict:
                 raise MXNetError("unknown forward input %s" % k)
@@ -375,6 +422,13 @@ class Executor(object):
                     self.aux_dict[name]._set_value(v)
         for name, val in collected.items():
             self._monitor_cb(name, NDArray(val))
+        from . import engine as _engine
+        from . import profiler as _profiler
+        if _engine.is_naive() or _profiler.is_running():
+            # sync so errors surface here (NaiveEngine) and the profiler
+            # scope reflects device time, not dispatch time
+            import jax as _jax
+            _jax.block_until_ready(outs)
         return self._output_nds
 
     def backward(self, out_grads=None):
@@ -383,6 +437,11 @@ class Executor(object):
         pullback of the last forward(is_train=True) — the forward is never
         re-executed, and stochastic ops (Dropout) reuse the masks saved in
         the forward's residuals, whether out_grads is implicit or explicit."""
+        from . import profiler as _profiler
+        with _profiler.Scope("executor.backward", "symbolic"):
+            return self._backward_impl(out_grads)
+
+    def _backward_impl(self, out_grads=None):
         gnames = self._grad_arg_names()
         if not gnames:
             return
@@ -418,10 +477,23 @@ class Executor(object):
         for name in gnames:
             req = self.grad_req[name]
             tgt = self.grad_dict[name]
-            if req == "write":
-                tgt._set_value(grads[name])
-            elif req == "add":
-                tgt._set_value(tgt.value + grads[name])
+            g = grads[name]
+            if req == "add":
+                # sequence-mesh training hands back mesh-committed grads;
+                # bring them to the accumulator's device before mixing
+                tv = tgt.value
+                if hasattr(g, "devices") and hasattr(tv, "devices") \
+                        and g.devices() != tv.devices():
+                    import jax as _jax
+                    g = _jax.device_put(g, next(iter(tv.devices())))
+                tgt._set_value(tv + g)
+            elif req == "write":
+                tgt._set_value(g)
+        from . import engine as _engine
+        from . import profiler as _profiler
+        if _engine.is_naive() or _profiler.is_running():
+            import jax as _jax
+            _jax.block_until_ready([g for g in grads.values()])
 
     def _forward_eager(self, is_train, rng, monitor=False):
         """Eager multi-device walk for group2ctx model parallelism: every op runs

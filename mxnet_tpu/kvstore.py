@@ -166,6 +166,15 @@ class KVStore(object):
     def set_barrier_before_exit(self, barrier_before_exit=True):
         self._barrier_before_exit = barrier_before_exit
 
+    def num_dead_node(self, node_id=0, timeout=30):
+        """Unreachable-peer count (parity: KVStore::get_num_dead_node,
+        include/mxnet/kvstore.h:242; here health = collectives complete —
+        see mxnet_tpu.parallel.elastic)."""
+        if not self.type.startswith("dist"):
+            return 0
+        from .parallel import elastic as _elastic
+        return _elastic.num_dead_node(node_id, timeout)
+
     def save_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("Cannot save states for distributed training")

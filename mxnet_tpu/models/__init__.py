@@ -7,6 +7,7 @@ from . import resnet
 from . import inception_v3
 from . import vgg
 from . import ssd
+from . import transformer
 
 get_lenet = lenet.get_symbol
 get_mlp = mlp.get_symbol

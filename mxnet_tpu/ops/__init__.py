@@ -21,3 +21,5 @@ from . import rnn_op         # noqa: F401  (rnn.cc / cudnn_rnn-inl.h)
 from . import spatial        # noqa: F401  (crop/grid/bilinear/st/roi/correlation)
 from . import contrib        # noqa: F401  (multibox_*, proposal, ctc_loss)
 from . import custom         # noqa: F401  (Custom — python callback op)
+from . import attention      # noqa: F401  (NEW: dot_product_attention/ring,
+                             #  LayerNorm — no reference analogue, §5.7)

@@ -1,0 +1,76 @@
+"""Attention operators (NEW capability — the reference has no attention op
+anywhere in src/operator, SURVEY.md §5.7; designed TPU-first from the start).
+
+``dot_product_attention`` is the core primitive: (B, H, T, D) Q/K/V in, same
+shape out.  When a sequence-parallel mesh is active
+(``mxnet_tpu.parallel.mesh.set_sequence_mesh``) it lowers to ring attention —
+K/V blocks rotating over the ``sp`` mesh axis via ``ppermute`` with
+online-softmax accumulation — so the same symbol graph scales from one chip
+to a long-context multi-chip ring without changes.
+
+``MultiHeadAttention``-style projections are composed at the symbol level
+(models/transformer.py) from FullyConnected/Reshape/transpose, keeping the
+MXU-shaped matmuls visible to XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, parse_bool, parse_float
+
+
+def _attn_infer(attrs, in_shapes):
+    q = in_shapes[0]
+    return list(in_shapes), [q], None
+
+
+@register("dot_product_attention", arg_names=("query", "key", "value"),
+          attr_types={"causal": parse_bool, "scale": parse_float},
+          defaults={"causal": False, "scale": None},
+          infer_shape=_attn_infer)
+def _dot_product_attention(query, key, value, causal=False, scale=None):
+    """Scaled dot-product attention over (B, H, T, D); ring-parallel when a
+    sequence mesh is active."""
+    from ..parallel import mesh as mesh_mod
+    from ..parallel import ring
+    mesh, axis = mesh_mod.sequence_mesh()
+    if mesh is not None:
+        return ring.ring_attention(query, key, value, mesh, axis=axis,
+                                   causal=causal, scale=scale)
+    return ring.attention_reference(query, key, value, causal=causal,
+                                    scale=scale)
+
+
+@register("position_ids", arg_names=("data",),
+          attr_types={"seq_len": int}, defaults={"seq_len": 0},
+          infer_shape=lambda attrs, ins: (list(ins), [ins[0]], None))
+def _position_ids(data, seq_len=0):
+    """Token positions 0..T-1 broadcast over the batch of a (B, T) input."""
+    t = data.shape[-1]
+    return jnp.broadcast_to(jnp.arange(t, dtype=jnp.float32), data.shape)
+
+
+@register("softmax_mask", arg_names=("data", "mask"))
+def _softmax_mask(data, mask):
+    """Masked softmax over the last axis (mask 1=keep, 0=drop)."""
+    neg = jnp.finfo(data.dtype).min
+    s = jnp.where(mask != 0, data, neg)
+    return jax.nn.softmax(s, axis=-1)
+
+
+@register("LayerNorm", arg_names=("data", "gamma", "beta"),
+          attr_types={"axis": int, "eps": parse_float},
+          defaults={"axis": -1, "eps": 1e-5},
+          infer_shape=lambda attrs, ins: (
+              [ins[0],
+               None if ins[0] is None else (ins[0][int(attrs.get("axis", -1))],),
+               None if ins[0] is None else (ins[0][int(attrs.get("axis", -1))],)],
+              [ins[0]], None))
+def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
+    """Layer normalization (transformer building block; HBM-friendly fused
+    mean/var on the fly — XLA fuses this into neighbouring matmuls)."""
+    mu = data.mean(axis=axis, keepdims=True)
+    var = ((data - mu) ** 2).mean(axis=axis, keepdims=True)
+    xhat = (data - mu) * jax.lax.rsqrt(var + eps)
+    return xhat * gamma + beta

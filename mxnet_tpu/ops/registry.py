@@ -286,7 +286,12 @@ def jitted(op, attrs, is_train=False):
     """Return the jit-compiled callable for (op, attrs, is_train)."""
     import jax
 
-    key = (op.name, attr_key(attrs), bool(is_train))
+    # sequence-parallel mesh changes attention lowering (shard_map ring);
+    # key it so toggling set_sequence_mesh never reuses a stale program
+    from ..parallel import mesh as _mesh_mod
+    seq_mesh, seq_axis = _mesh_mod.sequence_mesh()
+    seq_key = None if seq_mesh is None else (id(seq_mesh), seq_axis)
+    key = (op.name, attr_key(attrs), bool(is_train), seq_key)
     fn = _JIT_CACHE.get(key)
     if fn is None:
         fn = jax.jit(op.make_callable(attrs, is_train))
@@ -297,17 +302,38 @@ def jitted(op, attrs, is_train=False):
 def imperative_invoke(op_name, inputs, attrs=None, is_train=False, rng=None):
     """Run one op eagerly on jax arrays (parity: MXImperativeInvoke,
     src/c_api/c_api_ndarray.cc:323).  Returns a tuple of jax arrays
-    (visible outputs + aux updates)."""
+    (visible outputs + aux updates).  Under MXNET_ENGINE_TYPE=NaiveEngine
+    every op blocks on its result (sync debugging, parity: naive_engine.cc);
+    MXNET_ENGINE_NOJIT=1 bypasses the jit cache for op-level bisection."""
+    from .. import engine as _engine
     op = get_op(op_name) if isinstance(op_name, str) else op_name
     attrs = op.normalize_attrs(attrs or {})
-    fn = jitted(op, attrs, is_train)
+    import os
+    if _engine.is_naive() and os.environ.get("MXNET_ENGINE_NOJIT") == "1":
+        fn = op.make_callable(attrs, is_train)
+    else:
+        fn = jitted(op, attrs, is_train)
+    from .. import profiler as _prof
+    profiling = _prof.is_running() and \
+        _prof._state["mode"] in ("imperative", "all")
     if op.needs_rng:
         if rng is None:
             from .. import random as _random
             rng = _random.next_key()
-        out = fn(rng, *inputs)
+        args = (rng,) + tuple(inputs)
     else:
-        out = fn(*inputs)
+        args = tuple(inputs)
+    if profiling:
+        import jax
+        import time as _time
+        t0 = _time.time()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        _prof.record_event(op.name, t0 * 1e6, (_time.time() - t0) * 1e6,
+                           "imperative")
+    else:
+        out = fn(*args)
     if not isinstance(out, (tuple, list)):
         out = (out,)
+    _engine.maybe_wait(out)
     return tuple(out), op

@@ -3,9 +3,11 @@
 - ``mesh``: device-mesh helpers (dp/tp/pp/sp axes) over jax.sharding.Mesh
 - ``dist``: multi-host runtime (rank/size/allreduce/barrier) — the ps-lite/
   tracker replacement built on jax.distributed + XLA collectives over ICI/DCN
-- ``sharded``: sharded training-step builder (data/tensor parallel pjit)
-- ``ring``: ring attention / sequence parallelism (new capability; the
-  reference has none — SURVEY.md §5.7)
+- ``elastic``: failure detection + checkpoint-resume recovery (the ps-lite
+  heartbeat/is_recovery machinery, SURVEY.md §5.3, rebuilt TPU-native)
+- ``ring``: ring attention / sequence-context parallelism (new capability;
+  the reference has none — SURVEY.md §5.7)
 """
 from . import dist
 from . import mesh
+from . import elastic

@@ -1,0 +1,157 @@
+"""Failure detection + elastic recovery (parity: SURVEY.md §5.3 — the
+reference's ps-lite heartbeat machinery: ``KVStore::get_num_dead_node``
+(include/mxnet/kvstore.h:242, impl kvstore_dist.h:151-160 via
+``ps::Postoffice::GetDeadNodes``), ``is_recovery`` re-join
+(kvstore_dist.h:35-38), and worker restart via ``--load-epoch``).
+
+TPU-native design: there are no hot parameter servers to re-join — every
+process holds a replica, so recovery is checkpoint-resume:
+
+- *detection*: a dead host makes collectives hang; ``health_check`` bounds a
+  barrier with a timeout and reports the world unhealthy instead of hanging
+  forever.  ``num_dead_node`` keeps the reference API shape.
+- *recovery*: the launcher (tools/launch.py --max-restarts) respawns failed
+  processes with ``MXTPU_RESTART_COUNT`` incremented; ``is_recovery()`` tells
+  the program it is a respawn, and ``latest_checkpoint``/``resume_or_start``
+  pick up from the newest epoch checkpoint (the reference's
+  ``fit(..., begin_epoch=k)`` + ``--load-epoch`` pattern, automated).
+"""
+from __future__ import annotations
+
+import glob
+import os
+import re
+import threading
+
+from ..base import get_env
+
+__all__ = ["health_check", "num_dead_node", "is_recovery",
+           "latest_checkpoint", "resume_or_start", "fit_elastic"]
+
+
+_health_lock = threading.Lock()
+
+
+def health_check(timeout=30.0, name="health"):
+    """True when every process reaches a barrier within ``timeout`` seconds.
+
+    Replaces ps-lite heartbeat polling: on TPU a missing peer does not
+    heartbeat-timeout, it stalls the next collective — so health IS
+    "barriers still complete".  Runs the barrier on a daemon thread so a
+    dead world cannot hang the caller.
+
+    Caveat: a *timed-out* check leaves its barrier pending on the daemon
+    thread.  If the world was merely slow (not dead), that stale barrier can
+    desync later collectives — so treat False as fatal and restart the world
+    (the tools/launch.py --max-restarts supervisor does exactly this);
+    don't keep training after a failed health check.  A module-level lock
+    serialises checks within this process."""
+    from . import dist
+    ok = threading.Event()
+
+    def _barrier():
+        try:
+            dist.barrier(name)
+            ok.set()
+        except Exception:
+            pass
+
+    with _health_lock:
+        t = threading.Thread(target=_barrier, daemon=True)
+        t.start()
+        t.join(timeout)
+        return ok.is_set()
+
+
+def num_dead_node(node_id=0, timeout=30):
+    """Reference API shape (kvstore.h:242): number of unreachable nodes.
+
+    Binary on TPU: 0 when the world is healthy, else the number of peer
+    processes (any dead host fails the whole collective group)."""
+    import jax
+    from . import dist
+    dist.init_process_group()
+    if jax.process_count() <= 1:
+        return 0
+    return 0 if health_check(timeout=timeout) else jax.process_count() - 1
+
+
+def is_recovery():
+    """True when this process is a supervisor respawn (parity:
+    ps::Postoffice::is_recovery, kvstore_dist.h:35-38)."""
+    return int(get_env("MXTPU_RESTART_COUNT", "0") or "0") > 0
+
+
+_EPOCH_RE = re.compile(r"-(\d{4})\.params$")
+
+
+def latest_checkpoint(prefix):
+    """Newest epoch for ``prefix-%04d.params`` checkpoints, or None."""
+    best = None
+    for path in glob.glob("%s-*.params" % prefix):
+        m = _EPOCH_RE.search(path)
+        if m:
+            e = int(m.group(1))
+            best = e if best is None else max(best, e)
+    return best
+
+
+def resume_or_start(module, prefix, load_optimizer_states=False):
+    """Load the newest checkpoint into ``module`` if one exists.
+
+    Returns the epoch to pass as ``begin_epoch`` (0 when starting fresh).
+    The module must already be bound."""
+    epoch = latest_checkpoint(prefix)
+    if epoch is None:
+        return 0
+    from .. import model as model_mod
+    sym, arg_params, aux_params = model_mod.load_checkpoint(prefix, epoch)
+    module.set_params(arg_params, aux_params)
+    if load_optimizer_states and getattr(module, "optimizer_initialized",
+                                         False):
+        states = "%s-%04d.states" % (prefix, epoch)
+        if os.path.exists(states):
+            module.load_optimizer_states(states)
+    return epoch
+
+
+def fit_elastic(module, train_data, prefix, num_epoch, eval_data=None,
+                save_optimizer_states=True, **fit_kwargs):
+    """``Module.fit`` with per-epoch checkpointing and automatic resume.
+
+    On a fresh start trains epochs [0, num_epoch); after a crash + respawn
+    (or any rerun) it resumes from the newest ``prefix-NNNN.params``.  This
+    is the TPU-native replacement for the reference's PS hot-state recovery:
+    state lives in checkpoints, the supervisor restarts the world, training
+    continues where the last completed epoch left off."""
+    from .. import callback as callback_mod
+    begin = 0
+    if latest_checkpoint(prefix) is not None:
+        # bind is needed before set_params; fit() would bind lazily, so
+        # defer actual loading to arg_params via load_checkpoint
+        from .. import model as model_mod
+        epoch = latest_checkpoint(prefix)
+        _, arg_params, aux_params = model_mod.load_checkpoint(prefix, epoch)
+        fit_kwargs.setdefault("arg_params", arg_params)
+        fit_kwargs.setdefault("aux_params", aux_params)
+        begin = epoch
+        states = "%s-%04d.states" % (prefix, epoch)
+        if save_optimizer_states and os.path.exists(states):
+            # Module loads this after init_optimizer inside fit()
+            module._preload_opt_states = states
+    if begin >= num_epoch:
+        return module
+    cb = fit_kwargs.pop("epoch_end_callback", None)
+    ckpt = callback_mod.do_checkpoint(prefix)
+
+    def _ckpt_with_states(iter_no, sym, arg, aux):
+        ckpt(iter_no, sym, arg, aux)
+        if save_optimizer_states:
+            module.save_optimizer_states("%s-%04d.states"
+                                         % (prefix, iter_no + 1))
+
+    callbacks = [_ckpt_with_states] + ([cb] if cb else [])
+    module.fit(train_data, eval_data=eval_data, num_epoch=num_epoch,
+               begin_epoch=begin, epoch_end_callback=callbacks,
+               **fit_kwargs)
+    return module

@@ -14,7 +14,25 @@ import numpy as _np
 
 from ..base import MXNetError
 
-__all__ = ["make_mesh", "data_parallel_mesh", "local_devices_for"]
+__all__ = ["make_mesh", "data_parallel_mesh", "local_devices_for",
+           "set_sequence_mesh", "sequence_mesh"]
+
+# process-global sequence-parallel mesh: when set, attention ops lower to
+# ring attention over this mesh (see ops/attention.py)
+_seq_mesh = {"mesh": None, "axis": "sp"}
+
+
+def set_sequence_mesh(mesh, axis="sp"):
+    """Activate (or clear, with mesh=None) sequence/context parallelism:
+    subsequent `dot_product_attention` ops run ring attention with the
+    sequence axis sharded over ``axis`` of ``mesh``."""
+    _seq_mesh["mesh"] = mesh
+    _seq_mesh["axis"] = axis
+
+
+def sequence_mesh():
+    """(mesh, axis) of the active sequence-parallel config, mesh=None if off."""
+    return _seq_mesh["mesh"], _seq_mesh["axis"]
 
 
 def local_devices_for(ctx_list=None):

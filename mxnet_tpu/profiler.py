@@ -15,7 +15,7 @@ import time
 from .base import MXNetError, get_env
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "set_config", "set_state", "Scope"]
+           "set_config", "set_state", "Scope", "is_running", "record_event"]
 
 _state = {"mode": "symbolic", "filename": "profile.json", "running": False,
           "events": [], "jax_trace_dir": None}
@@ -57,6 +57,10 @@ def profiler_set_state(state="stop"):
 
 
 set_state = profiler_set_state
+
+
+def is_running():
+    return _state["running"]
 
 
 def record_event(name, start_us, dur_us, cat="operator", tid=0):

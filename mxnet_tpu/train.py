@@ -322,11 +322,17 @@ class TrainStep(object):
     # ------------------------------------------------------------------- call
     def __call__(self, params, opt_state, aux, batch, rng=None):
         """One fused step.  Returns (params, opt_state, aux, outputs)."""
+        from . import profiler as _profiler
         if rng is None:
             rng = _random.next_key()
         hyper = self.fopt.hyper(self.num_update)
         self.num_update += 1
-        return self._step(params, opt_state, aux, batch, rng, hyper)
+        with _profiler.Scope("train_step[%d]" % self.num_update, "symbolic"):
+            res = self._step(params, opt_state, aux, batch, rng, hyper)
+            if _profiler.is_running():
+                import jax
+                jax.block_until_ready(res[3])
+        return res
 
 
 class EvalStep(object):

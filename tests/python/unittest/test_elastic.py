@@ -1,0 +1,124 @@
+"""Failure-detection / elastic-recovery tests (SURVEY.md §5.3: the reference
+covers this only via ps-lite heartbeats; here: checkpoint-resume machinery +
+health API shapes, single-process, plus a crash-and-resume simulation)."""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import elastic
+
+RS = np.random.RandomState
+
+
+def _make_data(seed=0, n=120, nc=4, dim=16):
+    rng = RS(seed)
+    centers = rng.randn(nc, dim) * 3
+    y = rng.randint(0, nc, n)
+    x = centers[y] + rng.randn(n, dim)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def _mlp(nc=4):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=nc, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_latest_checkpoint(tmp_path):
+    prefix = str(tmp_path / "model")
+    assert elastic.latest_checkpoint(prefix) is None
+    for e in (1, 3, 2):
+        open("%s-%04d.params" % (prefix, e), "wb").close()
+    assert elastic.latest_checkpoint(prefix) == 3
+
+
+def test_is_recovery(monkeypatch):
+    monkeypatch.delenv("MXTPU_RESTART_COUNT", raising=False)
+    assert not elastic.is_recovery()
+    monkeypatch.setenv("MXTPU_RESTART_COUNT", "1")
+    assert elastic.is_recovery()
+
+
+def test_health_single_process():
+    assert elastic.health_check(timeout=20)
+    assert elastic.num_dead_node() == 0
+    kv = mx.kvstore.create("local")
+    assert kv.num_dead_node() == 0
+
+
+def test_fit_elastic_resume(tmp_path):
+    """Simulated crash: train 2 epochs + checkpoint, then a 'respawned'
+    module resumes from epoch 2 and finishes — final params match an
+    uninterrupted run batch-for-batch (both worlds see the same data
+    order and update counts)."""
+    prefix = str(tmp_path / "elastic")
+    x, y = _make_data()
+
+    def fresh_module():
+        return mx.Module(_mlp(), context=mx.cpu())
+
+    def iter_():
+        return mx.io.NDArrayIter(x, y, batch_size=30)
+
+    # uninterrupted reference run: 4 epochs
+    mx.random.seed(11)
+    ref = fresh_module()
+    elastic.fit_elastic(ref, iter_(), str(tmp_path / "ref"), num_epoch=4,
+                        optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1})
+    ref_params = {k: v.asnumpy() for k, v in ref.get_params()[0].items()}
+
+    # crashed run: stops after epoch 2 (checkpoints written)
+    mx.random.seed(11)
+    m1 = fresh_module()
+    elastic.fit_elastic(m1, iter_(), prefix, num_epoch=2,
+                        optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1})
+    assert elastic.latest_checkpoint(prefix) == 2
+
+    # respawn: picks up at epoch 2, trains to 4
+    m2 = fresh_module()
+    elastic.fit_elastic(m2, iter_(), prefix, num_epoch=4,
+                        optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1})
+    got = {k: v.asnumpy() for k, v in m2.get_params()[0].items()}
+    for k in ref_params:
+        np.testing.assert_allclose(got[k], ref_params[k], rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_fit_elastic_already_done(tmp_path):
+    """Resume past num_epoch is a no-op (world restarted after finishing)."""
+    prefix = str(tmp_path / "done")
+    x, y = _make_data(n=60)
+    mod = mx.Module(_mlp(), context=mx.cpu())
+    it = mx.io.NDArrayIter(x, y, batch_size=30)
+    elastic.fit_elastic(mod, it, prefix, num_epoch=2, optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1})
+    mod2 = mx.Module(_mlp(), context=mx.cpu())
+    it.reset()
+    out = elastic.fit_elastic(mod2, it, prefix, num_epoch=2,
+                              optimizer="sgd",
+                              optimizer_params={"learning_rate": 0.1})
+    assert out is mod2 and not mod2.binded  # never trained
+
+
+def test_launcher_restart_env():
+    """launch_local threads MXTPU_RESTART_COUNT through respawns."""
+    import subprocess
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    "..", "..", "..", "tools"))
+    try:
+        import launch
+    finally:
+        sys.path.pop(0)
+    # worker: fails on first attempt (restart count 0), succeeds on second
+    script = ("import os,sys;"
+              "sys.exit(0 if os.environ['MXTPU_RESTART_COUNT']=='1' else 3)")
+    rc = launch.launch_local(2, [sys.executable, "-c", script],
+                             max_restarts=2)
+    assert rc == 0

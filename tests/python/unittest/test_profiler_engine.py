@@ -1,0 +1,174 @@
+"""Profiler instrumentation + engine-swap + gradient-mirroring tests
+(parity model: reference example/profiler + MXNET_ENGINE_TYPE debug
+affordance, SURVEY.md §5.1-5.2 + graph_executor.cc mirror pass)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+RS = np.random.RandomState
+
+
+def _small_net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_profiler_records_executor_events(tmp_path):
+    fname = str(tmp_path / "profile.json")
+    mx.profiler.set_config(mode="symbolic", filename=fname)
+    mx.profiler.set_state("run")
+    try:
+        net = _small_net()
+        ex = net.simple_bind(mx.cpu(), data=(4, 10),
+                             softmax_label=(4,))
+        ex.forward(is_train=True,
+                   data=mx.nd.array(RS(0).rand(4, 10)),
+                   softmax_label=mx.nd.array([0, 1, 2, 3]))
+        ex.backward()
+    finally:
+        mx.profiler.set_state("stop")
+    mx.profiler.dump_profile()
+    with open(fname) as f:
+        trace = json.load(f)
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert any("executor.forward" in n for n in names), names
+    assert any("executor.backward" in n for n in names), names
+    durs = [e["dur"] for e in trace["traceEvents"]]
+    assert all(d >= 0 for d in durs)
+
+
+def test_profiler_imperative_mode(tmp_path):
+    fname = str(tmp_path / "imp.json")
+    mx.profiler.set_config(mode="imperative", filename=fname)
+    mx.profiler.set_state("run")
+    try:
+        a = mx.nd.ones((8, 8))
+        b = (a * 2 + 1).asnumpy()
+        assert (b == 3).all()
+    finally:
+        mx.profiler.set_state("stop")
+    mx.profiler.dump_profile()
+    with open(fname) as f:
+        trace = json.load(f)
+    cats = {e["cat"] for e in trace["traceEvents"]}
+    assert "imperative" in cats
+
+
+def test_train_step_profiled(tmp_path):
+    from mxnet_tpu.train import TrainStep
+    fname = str(tmp_path / "ts.json")
+    mx.profiler.set_config(mode="symbolic", filename=fname)
+    net = _small_net()
+    opt = mx.optimizer.SGD(learning_rate=0.1)
+    ts = TrainStep(net, opt)
+    params, state, aux = ts.init({"data": (4, 10)}, {"softmax_label": (4,)})
+    batch = ts.shard_batch({"data": RS(0).rand(4, 10).astype(np.float32),
+                            "softmax_label": np.array([0, 1, 2, 3],
+                                                      np.float32)})
+    mx.profiler.set_state("run")
+    try:
+        params, state, aux, outs = ts(params, state, aux, batch)
+    finally:
+        mx.profiler.set_state("stop")
+    mx.profiler.dump_profile()
+    with open(fname) as f:
+        trace = json.load(f)
+    assert any(e["name"].startswith("train_step") for e in
+               trace["traceEvents"])
+
+
+def test_naive_engine_sync():
+    """MXNET_ENGINE_TYPE=NaiveEngine forces synchronous execution."""
+    old = mx.engine.engine_type()
+    try:
+        mx.engine.set_engine_type("NaiveEngine")
+        assert mx.engine.is_naive()
+        a = mx.nd.ones((4, 4))
+        b = a + 1
+        # result must already be concrete; asnumpy is a no-op copy
+        assert (b.asnumpy() == 2).all()
+        net = _small_net()
+        ex = net.simple_bind(mx.cpu(), data=(2, 10), softmax_label=(2,))
+        out = ex.forward(is_train=True)[0]
+        ex.backward()
+        assert out.shape == (2, 4)
+    finally:
+        mx.engine.set_engine_type(old)
+
+
+def test_engine_type_env(monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+    mx.engine._state["type"] = None  # re-read env
+    assert mx.engine.engine_type() == "NaiveEngine"
+    mx.engine._state["type"] = None
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "BogusEngine")
+    with pytest.raises(mx.base.MXNetError):
+        mx.engine.engine_type()
+    monkeypatch.delenv("MXNET_ENGINE_TYPE")
+    mx.engine._state["type"] = None
+
+
+def test_backward_mirror_same_grads(monkeypatch):
+    """Gradient mirroring (remat) changes memory, never numerics."""
+    net = _small_net()
+    x = RS(0).rand(4, 10).astype(np.float32)
+    y = np.array([0, 1, 2, 3], np.float32)
+
+    def grads_with(mirror):
+        if mirror:
+            monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+        else:
+            monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR", raising=False)
+        mx.random.seed(5)
+        args = {"data": mx.nd.array(x), "softmax_label": mx.nd.array(y)}
+        arg_shapes, _, _ = net.infer_shape(data=(4, 10), softmax_label=(4,))
+        grads = {}
+        for n, s in zip(net.list_arguments(), arg_shapes):
+            if n in ("data", "softmax_label"):
+                continue
+            mx.random.seed(sum(map(ord, n)))
+            args[n] = mx.nd.uniform(low=-0.1, high=0.1, shape=s)
+            grads[n] = mx.nd.zeros(s)
+        ex = net.bind(mx.cpu(), args, args_grad=grads)
+        ex.forward(is_train=True)
+        ex.backward()
+        return {k: v.asnumpy() for k, v in grads.items()}
+
+    g0 = grads_with(False)
+    g1 = grads_with(True)
+    for k in g0:
+        np.testing.assert_allclose(g0[k], g1[k], rtol=1e-5, atol=1e-7)
+
+
+def test_trainstep_remat_same_loss():
+    """TrainStep(remat=True) matches remat=False numerically."""
+    from mxnet_tpu.train import TrainStep
+    net = _small_net()
+    x = RS(0).rand(4, 10).astype(np.float32)
+    y = np.array([0, 1, 2, 3], np.float32)
+
+    def run(remat):
+        opt = mx.optimizer.SGD(learning_rate=0.1)
+        ts = TrainStep(net, opt, remat=remat)
+        params, state, aux = ts.init({"data": (4, 10)},
+                                     {"softmax_label": (4,)}, seed=3)
+        batch = ts.shard_batch({"data": x, "softmax_label": y})
+        for _ in range(3):
+            params, state, aux, outs = ts(params, state, aux, batch)
+        return {k: np.asarray(v) for k, v in params.items()}
+
+    p0, p1 = run(False), run(True)
+    for k in p0:
+        np.testing.assert_allclose(p0[k], p1[k], rtol=1e-5, atol=1e-7)
+
+
+def test_waitall():
+    mx.nd.waitall()  # smoke: drains pending work without error
+    mx.engine.wait_all()

@@ -37,32 +37,58 @@ def _free_port():
     return port
 
 
-def launch_local(n, command, env_extra=None):
+def launch_local(n, command, env_extra=None, max_restarts=0):
     """Run n copies of `command` locally with the MXTPU_* env contract.
+
+    With ``max_restarts > 0`` acts as an elastic supervisor (parity: the
+    role the ps-lite scheduler's heartbeat + re-join machinery plays,
+    SURVEY.md §5.3): when any worker dies the whole world is torn down and
+    respawned with ``MXTPU_RESTART_COUNT`` incremented, and workers resume
+    from their newest checkpoint (mxnet_tpu.parallel.elastic).
     Returns the first non-zero exit code (0 if all succeed)."""
-    port = _free_port()
-    procs = []
-    for rank in range(n):
-        env = dict(os.environ)
-        env.update(env_extra or {})
-        env["MXTPU_COORDINATOR"] = "localhost:%d" % port
-        env["MXTPU_NUM_PROCESSES"] = str(n)
-        env["MXTPU_PROCESS_ID"] = str(rank)
-        procs.append(subprocess.Popen(command, env=env))
-    rc = 0
-    try:
-        for p in procs:
-            prc = p.wait()
-            rc = rc or prc
-    except KeyboardInterrupt:
-        for p in procs:
-            p.send_signal(signal.SIGINT)
-        rc = 1
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    return rc
+    attempt = 0
+    while True:
+        port = _free_port()
+        procs = []
+        for rank in range(n):
+            env = dict(os.environ)
+            env.update(env_extra or {})
+            env["MXTPU_COORDINATOR"] = "localhost:%d" % port
+            env["MXTPU_NUM_PROCESSES"] = str(n)
+            env["MXTPU_PROCESS_ID"] = str(rank)
+            env["MXTPU_RESTART_COUNT"] = str(attempt)
+            procs.append(subprocess.Popen(command, env=env))
+        rc = 0
+        try:
+            # poll, don't wait sequentially: a dead worker stalls survivors
+            # in collectives forever, so the first non-zero exit must tear
+            # the whole world down for the restart to ever fire
+            import time
+            while True:
+                codes = [p.poll() for p in procs]
+                failed = [c for c in codes if c not in (None, 0)]
+                if failed:
+                    rc = failed[0]
+                    for p in procs:
+                        if p.poll() is None:
+                            p.kill()
+                    break
+                if all(c == 0 for c in codes):
+                    break
+                time.sleep(0.2)
+        except KeyboardInterrupt:
+            for p in procs:
+                p.send_signal(signal.SIGINT)
+            return 1
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        if rc == 0 or attempt >= max_restarts:
+            return rc
+        attempt += 1
+        print("launch.py: worker failed (rc=%d), elastic restart %d/%d"
+              % (rc, attempt, max_restarts), file=sys.stderr)
 
 
 def launch_ssh(hosts, command, env_extra=None):
@@ -95,12 +121,16 @@ def main():
     ap.add_argument("--launcher", choices=("local", "ssh"), default="local")
     ap.add_argument("--hostfile", default=None,
                     help="file with one host per line (ssh launcher)")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="elastic supervision: respawn the world up to this "
+                         "many times after a worker failure")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
         ap.error("no command given")
     if args.launcher == "local":
-        rc = launch_local(args.num_workers, args.command)
+        rc = launch_local(args.num_workers, args.command,
+                          max_restarts=args.max_restarts)
     else:
         with open(args.hostfile) as f:
             hosts = [h.strip() for h in f if h.strip()]
