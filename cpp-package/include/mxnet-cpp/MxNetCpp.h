@@ -1,0 +1,183 @@
+/*
+ * Header-only C++ frontend (parity: reference cpp-package/include/mxnet-cpp/
+ * — NDArray/Symbol/Predictor value classes over the C API).
+ *
+ * TPU-native: identical user surface, but binds to libmxnet_tpu.so whose
+ * compute path is XLA.  RAII handles, exceptions on failure.
+ */
+#ifndef MXNET_CPP_MXNETCPP_H_
+#define MXNET_CPP_MXNETCPP_H_
+
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu/c_api.h"
+#include "mxnet_tpu/c_predict_api.h"
+
+namespace mxnet {
+namespace cpp {
+
+inline void Check(int rc) {
+  if (rc != 0) throw std::runtime_error(MXGetLastError());
+}
+
+class Context {
+ public:
+  Context(int dev_type, int dev_id) : type_(dev_type), id_(dev_id) {}
+  static Context cpu(int id = 0) { return Context(1, id); }
+  static Context gpu(int id = 0) { return Context(2, id); }
+  static Context tpu(int id = 0) { return Context(4, id); }
+  int dev_type() const { return type_; }
+  int dev_id() const { return id_; }
+
+ private:
+  int type_, id_;
+};
+
+class NDArray {
+ public:
+  NDArray(const std::vector<mx_uint> &shape, const Context &ctx) {
+    Check(MXNDArrayCreate(shape.data(),
+                          static_cast<mx_uint>(shape.size()),
+                          ctx.dev_type(), ctx.dev_id(), 0, &handle_));
+  }
+  explicit NDArray(NDArrayHandle handle) : handle_(handle) {}
+  NDArray(const NDArray &) = delete;
+  NDArray &operator=(const NDArray &) = delete;
+  NDArray(NDArray &&other) noexcept : handle_(other.handle_) {
+    other.handle_ = nullptr;
+  }
+  ~NDArray() {
+    if (handle_ != nullptr) MXNDArrayFree(handle_);
+  }
+
+  void SyncCopyFromCPU(const std::vector<mx_float> &data) {
+    Check(MXNDArraySyncCopyFromCPU(handle_, data.data(), data.size()));
+  }
+  std::vector<mx_float> SyncCopyToCPU() const {
+    std::vector<mx_float> out(Size());
+    Check(MXNDArraySyncCopyToCPU(handle_, out.data(), out.size()));
+    return out;
+  }
+  std::vector<mx_uint> Shape() const {
+    mx_uint ndim = 0;
+    const mx_uint *data = nullptr;
+    Check(MXNDArrayGetShape(handle_, &ndim, &data));
+    return std::vector<mx_uint>(data, data + ndim);
+  }
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint d : Shape()) n *= d;
+    return n;
+  }
+  NDArrayHandle handle() const { return handle_; }
+
+ private:
+  NDArrayHandle handle_ = nullptr;
+};
+
+class Symbol {
+ public:
+  static Symbol FromJSON(const std::string &json) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h));
+    return Symbol(h);
+  }
+  static Symbol FromFile(const std::string &fname) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromFile(fname.c_str(), &h));
+    return Symbol(h);
+  }
+  explicit Symbol(SymbolHandle h) : handle_(h) {}
+  Symbol(const Symbol &) = delete;
+  Symbol &operator=(const Symbol &) = delete;
+  Symbol(Symbol &&other) noexcept : handle_(other.handle_) {
+    other.handle_ = nullptr;
+  }
+  ~Symbol() {
+    if (handle_ != nullptr) MXSymbolFree(handle_);
+  }
+
+  std::string ToJSON() const {
+    const char *json = nullptr;
+    Check(MXSymbolSaveToJSON(handle_, &json));
+    return json;
+  }
+  std::vector<std::string> ListArguments() const {
+    return StrList(&MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return StrList(&MXSymbolListOutputs);
+  }
+  SymbolHandle handle() const { return handle_; }
+
+ private:
+  template <typename F>
+  std::vector<std::string> StrList(F fn) const {
+    mx_uint n = 0;
+    const char **arr = nullptr;
+    Check(fn(handle_, &n, &arr));
+    std::vector<std::string> out;
+    for (mx_uint i = 0; i < n; ++i) out.emplace_back(arr[i]);
+    return out;
+  }
+  SymbolHandle handle_ = nullptr;
+};
+
+/* Forward-only inference (parity: cpp predict usage of MXPred*). */
+class Predictor {
+ public:
+  Predictor(const std::string &symbol_json, const std::string &param_bytes,
+            const Context &ctx,
+            const std::vector<std::pair<std::string,
+                                        std::vector<mx_uint>>> &inputs) {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> shapes;
+    for (auto &kv : inputs) {
+      keys.push_back(kv.first.c_str());
+      for (mx_uint d : kv.second) shapes.push_back(d);
+      indptr.push_back(static_cast<mx_uint>(shapes.size()));
+    }
+    Check(MXPredCreate(symbol_json.c_str(), param_bytes.data(),
+                       static_cast<int>(param_bytes.size()),
+                       ctx.dev_type(), ctx.dev_id(),
+                       static_cast<mx_uint>(inputs.size()), keys.data(),
+                       indptr.data(), shapes.data(), &handle_));
+  }
+  Predictor(const Predictor &) = delete;
+  Predictor &operator=(const Predictor &) = delete;
+  ~Predictor() {
+    if (handle_ != nullptr) MXPredFree(handle_);
+  }
+
+  void SetInput(const std::string &key, const std::vector<mx_float> &data) {
+    Check(MXPredSetInput(handle_, key.c_str(), data.data(),
+                         static_cast<mx_uint>(data.size())));
+  }
+  void Forward() { Check(MXPredForward(handle_)); }
+  std::vector<mx_uint> GetOutputShape(mx_uint index = 0) {
+    mx_uint *data = nullptr;
+    mx_uint ndim = 0;
+    Check(MXPredGetOutputShape(handle_, index, &data, &ndim));
+    return std::vector<mx_uint>(data, data + ndim);
+  }
+  std::vector<mx_float> GetOutput(mx_uint index = 0) {
+    auto shape = GetOutputShape(index);
+    size_t n = 1;
+    for (mx_uint d : shape) n *= d;
+    std::vector<mx_float> out(n);
+    Check(MXPredGetOutput(handle_, index, out.data(),
+                          static_cast<mx_uint>(n)));
+    return out;
+  }
+
+ private:
+  PredictorHandle handle_ = nullptr;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  // MXNET_CPP_MXNETCPP_H_

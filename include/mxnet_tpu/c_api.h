@@ -1,0 +1,78 @@
+/*
+ * C API for the mxnet_tpu framework (parity: reference include/mxnet/c_api.h).
+ *
+ * The reference exposes 111 MXNET_DLL functions over its C++ core; this
+ * boundary exposes the same contract style (opaque handles, int return code,
+ * MXGetLastError) over the TPU-native core.  Implementation:
+ * src/c_api/c_api.cc embeds CPython and dispatches to mxnet_tpu.capi —
+ * the compute underneath is XLA, exactly as the Python frontend uses it.
+ *
+ * Conventions (identical to the reference):
+ *  - every function returns 0 on success, -1 on failure;
+ *  - MXGetLastError() returns the failure message for this thread;
+ *  - handles must be freed with their MX*Free function.
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#include <stddef.h>
+#include <stdint.h>
+
+#define MXNET_DLL __attribute__((visibility("default")))
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+
+/*! \brief return the last error message on this thread */
+MXNET_DLL const char *MXGetLastError();
+
+/*! \brief library initialisation (embeds the Python core; idempotent) */
+MXNET_DLL int MXTPULibInit();
+/*! \brief notify the engine about a shutdown (parity: MXNotifyShutdown) */
+MXNET_DLL int MXNotifyShutdown();
+/*! \brief seed all random generators (parity: MXRandomSeed) */
+MXNET_DLL int MXRandomSeed(int seed);
+
+/* --------------------------------------------------------------- NDArray */
+MXNET_DLL int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim,
+                              int dev_type, int dev_id, int delay_alloc,
+                              NDArrayHandle *out);
+MXNET_DLL int MXNDArrayFree(NDArrayHandle handle);
+MXNET_DLL int MXNDArraySyncCopyFromCPU(NDArrayHandle handle,
+                                       const void *data, size_t size);
+MXNET_DLL int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                                     size_t size);
+MXNET_DLL int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                                const mx_uint **out_pdata);
+MXNET_DLL int MXNDArraySave(const char *fname, mx_uint num_args,
+                            NDArrayHandle *args, const char **keys);
+MXNET_DLL int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                            NDArrayHandle **out_arr, mx_uint *out_name_size,
+                            const char ***out_names);
+MXNET_DLL int MXNDArrayWaitAll();
+
+/* ---------------------------------------------------------------- Symbol */
+MXNET_DLL int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+MXNET_DLL int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+MXNET_DLL int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+MXNET_DLL int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json);
+MXNET_DLL int MXSymbolFree(SymbolHandle symbol);
+MXNET_DLL int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                                    const char ***out_str_array);
+MXNET_DLL int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                                  const char ***out_str_array);
+MXNET_DLL int MXSymbolListAuxiliaryStates(SymbolHandle symbol,
+                                          mx_uint *out_size,
+                                          const char ***out_str_array);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_API_H_ */

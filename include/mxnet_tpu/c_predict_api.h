@@ -1,0 +1,49 @@
+/*
+ * C predict API (parity: reference include/mxnet/c_predict_api.h,
+ * src/c_api/c_predict_api.cc:1-334 — the stable small inference surface
+ * that amalgamation/mobile builds ship).
+ *
+ * Flow: MXPredCreate(symbol json, params blob) -> MXPredSetInput ->
+ * MXPredForward -> MXPredGetOutputShape -> MXPredGetOutput -> MXPredFree.
+ * Tensor data crosses as float32.
+ */
+#ifndef MXNET_TPU_C_PREDICT_API_H_
+#define MXNET_TPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifndef MXNET_DLL
+#define MXNET_DLL __attribute__((visibility("default")))
+#endif
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+
+MXNET_DLL int MXPredCreate(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           PredictorHandle *out);
+MXNET_DLL int MXPredSetInput(PredictorHandle handle, const char *key,
+                             const mx_float *data, mx_uint size);
+MXNET_DLL int MXPredForward(PredictorHandle handle);
+MXNET_DLL int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                                   mx_uint **shape_data, mx_uint *shape_ndim);
+MXNET_DLL int MXPredGetOutput(PredictorHandle handle, mx_uint index,
+                              mx_float *data, mx_uint size);
+MXNET_DLL int MXPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_PREDICT_API_H_ */

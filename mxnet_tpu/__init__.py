@@ -41,6 +41,8 @@ from . import visualization as viz
 from . import recordio
 from . import profiler
 from . import engine
+from . import predictor
+from .predictor import Predictor
 from . import rnn
 from . import test_utils
 

@@ -1,0 +1,149 @@
+"""Python-side shim for the native C API (src/c_api/c_api.cc).
+
+The reference's C boundary (include/mxnet/c_api.h, 111 MXNET_DLL functions)
+wraps its C++ core; here the "core" is the Python graph layer + XLA compute,
+so libmxnet_tpu.so embeds CPython and calls these flat functions.  Every
+function takes/returns only simple types (ints, strings, bytes, tuples) so
+the C++ marshalling stays trivial; handles on the C side are PyObject
+pointers to the objects returned here.
+
+Raw tensor bytes cross the boundary as little-endian float32 (the C predict
+API's contract, reference src/c_api/c_predict_api.cc MXPredSetInput /
+MXPredGetOutput).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import ndarray as nd
+from . import random as _random
+from . import symbol as sym_mod
+from .context import Context
+from .predictor import Predictor
+
+_DEVTYPE = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 4: "tpu"}
+
+
+def _ctx(dev_type, dev_id):
+    return Context(_DEVTYPE.get(int(dev_type), "cpu"), int(dev_id))
+
+
+# ------------------------------------------------------------------ ndarray
+def nd_create(shape, dev_type, dev_id):
+    return nd.zeros(tuple(int(x) for x in shape), ctx=_ctx(dev_type, dev_id))
+
+
+def nd_from_bytes(data, shape, dev_type, dev_id):
+    arr = _np.frombuffer(data, dtype="<f4").reshape(
+        tuple(int(x) for x in shape))
+    return nd.array(arr, ctx=_ctx(dev_type, dev_id))
+
+
+def nd_sync_copy_from(handle, data):
+    arr = _np.frombuffer(data, dtype="<f4").reshape(handle.shape)
+    handle[:] = arr
+
+
+def nd_sync_copy_to(handle):
+    return _np.ascontiguousarray(
+        handle.asnumpy().astype("<f4", copy=False)).tobytes()
+
+
+def nd_get_shape(handle):
+    return tuple(int(x) for x in handle.shape)
+
+
+def nd_save(fname, handles, names):
+    if names:
+        nd.save(fname, dict(zip(names, handles)))
+    else:
+        nd.save(fname, list(handles))
+
+
+def nd_load(fname):
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        names = list(data)
+        return [data[n] for n in names], names
+    return list(data), []
+
+
+def nd_waitall():
+    nd.waitall()
+
+
+# ------------------------------------------------------------------- symbol
+def list_all_op_names():
+    from .ops import registry
+    return sorted(registry.list_ops())
+
+
+def symbol_create_from_json(json_str):
+    return sym_mod.load_json(json_str)
+
+
+def symbol_save_to_json(handle):
+    return handle.tojson()
+
+
+def symbol_list_arguments(handle):
+    return list(handle.list_arguments())
+
+
+def symbol_list_outputs(handle):
+    return list(handle.list_outputs())
+
+
+def symbol_list_auxiliary_states(handle):
+    return list(handle.list_auxiliary_states())
+
+
+def symbol_infer_shape(handle, names, shapes):
+    kwargs = {n: tuple(s) for n, s in zip(names, shapes)}
+    arg_shapes, out_shapes, aux_shapes = handle.infer_shape(**kwargs)
+    if arg_shapes is None:
+        return None
+    return (tuple(map(tuple, arg_shapes)), tuple(map(tuple, out_shapes)),
+            tuple(map(tuple, aux_shapes)))
+
+
+# ---------------------------------------------------------------- predictor
+def pred_create(symbol_json, param_bytes, dev_type, dev_id, input_names,
+                input_shapes):
+    shapes = {n: tuple(int(x) for x in s)
+              for n, s in zip(input_names, input_shapes)}
+    return Predictor(symbol_json, bytes(param_bytes), shapes,
+                     _DEVTYPE.get(int(dev_type), "cpu"), int(dev_id))
+
+
+def pred_set_input(pred, name, data):
+    shape = None
+    for n in pred._input_names:
+        if n == name:
+            shape = pred._executor.arg_dict[n].shape
+    if shape is None:
+        raise KeyError(name)
+    arr = _np.frombuffer(data, dtype="<f4")
+    pred.set_input(name, arr.reshape(shape))
+
+
+def pred_forward(pred):
+    pred.forward()
+
+
+def pred_num_outputs(pred):
+    return int(pred.num_outputs)
+
+
+def pred_get_output_shape(pred, index):
+    return tuple(int(x) for x in pred.get_output_shape(int(index)))
+
+
+def pred_get_output(pred, index):
+    out = pred.get_output(int(index))
+    return _np.ascontiguousarray(out.astype("<f4", copy=False)).tobytes()
+
+
+# ------------------------------------------------------------------- random
+def random_seed(seed):
+    _random.seed(int(seed))

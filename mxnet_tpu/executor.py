@@ -273,9 +273,15 @@ class Executor(object):
         # attention op lowers to shard_map over it), so it must key the cache:
         # toggling set_sequence_mesh would otherwise reuse stale lowerings
         from .parallel import mesh as mesh_mod
+        from .base import get_env
         seq_mesh, seq_axis = mesh_mod.sequence_mesh()
+        # mirror flags are read at trace time, so they key the cache too —
+        # toggling MXNET_BACKWARD_DO_MIRROR after an OOM must take effect
+        mirror_key = (get_env("MXNET_BACKWARD_DO_MIRROR", "0"),
+                      get_env("MXNET_BACKWARD_MIRROR_POLICY", ""))
         cache_key = (kind,
-                     None if seq_mesh is None else (id(seq_mesh), seq_axis))
+                     None if seq_mesh is None else (id(seq_mesh), seq_axis),
+                     mirror_key)
         fn = self._jit_cache.get(cache_key)
         if fn is not None:
             return fn

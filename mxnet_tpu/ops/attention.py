@@ -46,8 +46,13 @@ def _dot_product_attention(query, key, value, causal=False, scale=None):
           attr_types={"seq_len": int}, defaults={"seq_len": 0},
           infer_shape=lambda attrs, ins: (list(ins), [ins[0]], None))
 def _position_ids(data, seq_len=0):
-    """Token positions 0..T-1 broadcast over the batch of a (B, T) input."""
+    """Token positions 0..T-1 broadcast over the batch of a (B, T) input.
+    ``seq_len``, when given, must agree with the data width (it exists so
+    the position-embedding table size is visible in the symbol attrs)."""
     t = data.shape[-1]
+    if seq_len and int(seq_len) != int(t):
+        raise ValueError("position_ids: seq_len=%d != data width %d"
+                         % (seq_len, t))
     return jnp.broadcast_to(jnp.arange(t, dtype=jnp.float32), data.shape)
 
 

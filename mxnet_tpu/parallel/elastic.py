@@ -132,8 +132,11 @@ def fit_elastic(module, train_data, prefix, num_epoch, eval_data=None,
         from .. import model as model_mod
         epoch = latest_checkpoint(prefix)
         _, arg_params, aux_params = model_mod.load_checkpoint(prefix, epoch)
-        fit_kwargs.setdefault("arg_params", arg_params)
-        fit_kwargs.setdefault("aux_params", aux_params)
+        # the checkpoint MUST win over caller-supplied initial params: on a
+        # crash-resume, keeping e.g. the original pretrained weights while
+        # skipping to begin_epoch would silently lose the trained epochs
+        fit_kwargs["arg_params"] = arg_params
+        fit_kwargs["aux_params"] = aux_params
         begin = epoch
         states = "%s-%04d.states" % (prefix, epoch)
         if save_optimizer_states and os.path.exists(states):
@@ -150,7 +153,13 @@ def fit_elastic(module, train_data, prefix, num_epoch, eval_data=None,
             module.save_optimizer_states("%s-%04d.states"
                                          % (prefix, iter_no + 1))
 
-    callbacks = [_ckpt_with_states] + ([cb] if cb else [])
+    if cb is None:
+        extra = []
+    elif isinstance(cb, (list, tuple)):
+        extra = list(cb)
+    else:
+        extra = [cb]
+    callbacks = [_ckpt_with_states] + extra
     module.fit(train_data, eval_data=eval_data, num_epoch=num_epoch,
                begin_epoch=begin, epoch_end_callback=callbacks,
                **fit_kwargs)

@@ -1,0 +1,134 @@
+"""Inference-only predictor (parity: reference src/c_api/c_predict_api.cc
+MXPred* — load saved symbol JSON + params blob, bind a forward-only
+executor, feed inputs, read outputs).
+
+TPU-first: the forward pass is ONE jit-compiled XLA computation (the
+MXNET_PREDICT_ONLY/NaiveEngine distinction disappears — inference is always
+the maximally-bulked path).  This module is both the Python inference API
+and the engine behind the native C predict API (src/c_api/c_api.cc)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from . import ndarray as nd
+from . import symbol as sym_mod
+
+__all__ = ["Predictor"]
+
+
+class Predictor(object):
+    """Forward-only bound model.
+
+    Parameters
+    ----------
+    symbol : Symbol or JSON string (the ``-symbol.json`` content)
+    param_blob : dict of params, a ``.params`` path, or raw bytes of one
+    input_shapes : {name: shape} for all data inputs
+    dev_type / dev_id : placement (parity: MXPredCreate signature)
+    """
+
+    def __init__(self, symbol, param_blob, input_shapes, dev_type="cpu",
+                 dev_id=0):
+        from .context import Context
+        if isinstance(symbol, (str, bytes)):
+            symbol = sym_mod.load_json(
+                symbol.decode() if isinstance(symbol, bytes) else symbol)
+        self.symbol = symbol
+        ctx = Context(dev_type, dev_id)
+        arg_params, aux_params = _load_params(param_blob)
+
+        input_shapes = {k: tuple(int(x) for x in v)
+                        for k, v in input_shapes.items()}
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**input_shapes)
+        if arg_shapes is None:
+            raise MXNetError("Predictor: cannot infer shapes from %r"
+                             % (input_shapes,))
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        self._input_names = list(input_shapes)
+        # params not in the blob (e.g. the loss head's label input) bind as
+        # zeros — reference c_predict_api.cc:191-195 does exactly this
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in arg_params and name not in input_shapes:
+                args[name] = arg_params[name].copyto(ctx)
+            else:
+                args[name] = nd.zeros(shape, ctx=ctx)
+        auxs = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            if name in aux_params:
+                auxs[name] = aux_params[name].copyto(ctx)
+            else:
+                auxs[name] = nd.zeros(shape, ctx=ctx)
+        self._executor = symbol.bind(ctx, args, aux_states=auxs,
+                                     grad_req="null")
+        self._outputs = None
+
+    # ------------------------------------------------------------------- api
+    def set_input(self, name, value):
+        """(parity: MXPredSetInput)"""
+        if name not in self._input_names:
+            raise MXNetError("unknown input %s (have %s)"
+                             % (name, self._input_names))
+        self._executor.arg_dict[name][:] = _np.asarray(value,
+                                                       dtype=_np.float32)
+
+    def forward(self):
+        """(parity: MXPredForward)"""
+        self._outputs = self._executor.forward(is_train=False)
+
+    def get_output_shape(self, index=0):
+        """(parity: MXPredGetOutputShape)"""
+        outs = self._outputs or self._executor.outputs
+        return tuple(outs[index].shape)
+
+    def get_output(self, index=0):
+        """Blocking copy of one output to host numpy (parity: MXPredGetOutput)."""
+        if self._outputs is None:
+            raise MXNetError("call forward() first")
+        return self._outputs[index].asnumpy()
+
+    @property
+    def num_outputs(self):
+        return len(self._executor.outputs)
+
+    # ------------------------------------------------------------- factories
+    @staticmethod
+    def from_checkpoint(prefix, epoch, input_shapes, dev_type="cpu",
+                        dev_id=0):
+        """Load ``prefix-symbol.json`` + ``prefix-%04d.params``."""
+        with open("%s-symbol.json" % prefix) as f:
+            sym_json = f.read()
+        with open("%s-%04d.params" % (prefix, epoch), "rb") as f:
+            blob = f.read()
+        return Predictor(sym_json, blob, input_shapes, dev_type, dev_id)
+
+
+def _load_params(param_blob):
+    """Accept a dict, a .params path, or raw bytes of a .params file."""
+    import io
+    import os
+    import tempfile
+    if isinstance(param_blob, dict):
+        raw = param_blob
+    elif isinstance(param_blob, (bytes, bytearray)):
+        # nd.load reads from a path; stage the blob
+        fd, path = tempfile.mkstemp(suffix=".params")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(param_blob)
+            raw = nd.load(path)
+        finally:
+            os.unlink(path)
+    else:
+        raw = nd.load(param_blob)
+    arg_params, aux_params = {}, {}
+    for k, v in raw.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params

@@ -1,0 +1,478 @@
+/*
+ * Native C API implementation (parity: reference src/c_api/c_api.cc +
+ * c_api_error.cc + c_predict_api.cc).
+ *
+ * Architecture (TPU-native, not a port): the reference's C boundary wraps a
+ * C++ engine/executor core.  Here the compute core is XLA and the graph
+ * layer is Python, so this library embeds CPython and dispatches each C call
+ * to the flat shim functions in mxnet_tpu/capi.py.  What stays identical to
+ * the reference is the *contract*: opaque handles, 0/-1 return codes,
+ * thread-local MXGetLastError, API_BEGIN/API_END structure
+ * (reference src/c_api/c_api_common.h).
+ *
+ * Handles are PyObject* (INCREF'd on creation, DECREF'd in MX*Free) — the
+ * same ownership discipline the reference applies to its C++ objects.
+ */
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "mxnet_tpu/c_api.h"
+#include "mxnet_tpu/c_predict_api.h"
+
+namespace {
+
+thread_local std::string last_error;
+
+/* per-thread scratch keeping returned pointers alive until the next call on
+ * the same thread (the reference uses MXAPIThreadLocalEntry identically) */
+struct ThreadLocalScratch {
+  std::vector<std::string> strings;
+  std::vector<const char *> cstrs;
+  std::vector<mx_uint> shape;
+  std::string json;
+  std::vector<void *> handles;
+};
+thread_local ThreadLocalScratch scratch;
+
+std::once_flag init_flag;
+PyObject *capi_module = nullptr;          // mxnet_tpu.capi
+PyThreadState *main_tstate = nullptr;
+
+void EnsureRuntime() {
+  std::call_once(init_flag, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL taken by Py_Initialize so API calls below can use
+      // PyGILState_Ensure from any thread (standalone C++ programs)
+      main_tstate = PyEval_SaveThread();
+    }
+    PyGILState_STATE g = PyGILState_Ensure();
+    capi_module = PyImport_ImportModule("mxnet_tpu.capi");
+    if (capi_module == nullptr) {
+      PyErr_Print();
+    }
+    PyGILState_Release(g);
+  });
+}
+
+std::string FetchPyError() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return msg;
+}
+
+class GILGuard {
+ public:
+  GILGuard() : state_(PyGILState_Ensure()) {}
+  ~GILGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+/* Call capi.<fn>(args...); returns new reference or nullptr (python error
+ * pending).  The GIL must be held. */
+PyObject *CallShim(const char *fn, PyObject *args) {
+  if (capi_module == nullptr) {
+    PyErr_SetString(PyExc_RuntimeError, "mxnet_tpu.capi failed to import");
+    return nullptr;
+  }
+  PyObject *f = PyObject_GetAttrString(capi_module, fn);
+  if (f == nullptr) return nullptr;
+  PyObject *ret = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return ret;
+}
+
+PyObject *ShapeTuple(const mx_uint *shape, mx_uint ndim) {
+  PyObject *t = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(t, i, PyLong_FromUnsignedLong(shape[i]));
+  }
+  return t;
+}
+
+int StrListOut(PyObject *list, mx_uint *out_size, const char ***out_array) {
+  Py_ssize_t n = PyList_Size(list);
+  scratch.strings.clear();
+  scratch.cstrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    scratch.strings.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(list, i)));
+  }
+  for (auto &s : scratch.strings) scratch.cstrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = scratch.cstrs.data();
+  return 0;
+}
+
+}  // namespace
+
+#define API_BEGIN()                \
+  EnsureRuntime();                 \
+  GILGuard gil_guard__;            \
+  try {
+#define API_END()                                  \
+  }                                                \
+  catch (const std::exception &e) {                \
+    last_error = e.what();                         \
+    return -1;                                     \
+  }                                                \
+  return 0;
+#define CHECK_PY(expr)                  \
+  if ((expr) == nullptr) {              \
+    last_error = FetchPyError();        \
+    return -1;                          \
+  }
+
+extern "C" {
+
+const char *MXGetLastError() { return last_error.c_str(); }
+
+int MXTPULibInit() {
+  EnsureRuntime();
+  GILGuard gil;
+  return capi_module != nullptr ? 0 : -1;
+}
+
+int MXNotifyShutdown() {
+  API_BEGIN();
+  PyObject *r = CallShim("nd_waitall", nullptr);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXRandomSeed(int seed) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(i)", seed);
+  PyObject *r = CallShim("random_seed", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ----------------------------------------------------------------- NDArray */
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out) {
+  (void)delay_alloc;  // XLA owns allocation; the hint is meaningless here
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(Nii)", ShapeTuple(shape, ndim), dev_type,
+                                 dev_id);
+  PyObject *r = CallShim("nd_create", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;  // keep the reference as the handle
+  API_END();
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  API_BEGIN();
+  Py_XDECREF(reinterpret_cast<PyObject *>(handle));
+  API_END();
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  API_BEGIN();
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data), size * sizeof(mx_float));
+  PyObject *args = Py_BuildValue("(ON)",
+                                 reinterpret_cast<PyObject *>(handle), bytes);
+  PyObject *r = CallShim("nd_sync_copy_from", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("nd_sync_copy_to", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(r, &buf, &len);
+  size_t want = size * sizeof(mx_float);
+  std::memcpy(data, buf, len < static_cast<Py_ssize_t>(want)
+                             ? static_cast<size_t>(len) : want);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("nd_get_shape", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_ssize_t n = PyTuple_Size(r);
+  scratch.shape.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    scratch.shape.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(r, i))));
+  }
+  Py_DECREF(r);
+  *out_dim = static_cast<mx_uint>(n);
+  *out_pdata = scratch.shape.data();
+  API_END();
+}
+
+int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args_h,
+                  const char **keys) {
+  API_BEGIN();
+  PyObject *handles = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject *o = reinterpret_cast<PyObject *>(args_h[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(handles, i, o);
+  }
+  PyObject *names = PyList_New(0);
+  if (keys != nullptr) {
+    for (mx_uint i = 0; i < num_args; ++i) {
+      PyObject *s = PyUnicode_FromString(keys[i]);
+      PyList_Append(names, s);
+      Py_DECREF(s);
+    }
+  }
+  PyObject *args = Py_BuildValue("(sNN)", fname, handles, names);
+  PyObject *r = CallShim("nd_save", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(s)", fname);
+  PyObject *r = CallShim("nd_load", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  PyObject *arrs = PyTuple_GetItem(r, 0);
+  PyObject *names = PyTuple_GetItem(r, 1);
+  Py_ssize_t n = PyList_Size(arrs);
+  scratch.handles.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(arrs, i);
+    Py_INCREF(o);
+    scratch.handles.push_back(o);
+  }
+  *out_size = static_cast<mx_uint>(n);
+  *out_arr = scratch.handles.data();
+  StrListOut(names, out_name_size, out_names);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayWaitAll() {
+  API_BEGIN();
+  PyObject *r = CallShim("nd_waitall", nullptr);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ------------------------------------------------------------------ Symbol */
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  API_BEGIN();
+  PyObject *r = CallShim("list_all_op_names", nullptr);
+  CHECK_PY(r);
+  StrListOut(r, out_size, out_array);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(s)", json);
+  PyObject *r = CallShim("symbol_create_from_json", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  API_BEGIN();
+  FILE *f = fopen(fname, "rb");
+  if (f == nullptr) {
+    last_error = std::string("cannot open ") + fname;
+    return -1;
+  }
+  std::string json;
+  char buf[4096];
+  size_t got;
+  while ((got = fread(buf, 1, sizeof(buf), f)) > 0) json.append(buf, got);
+  fclose(f);
+  PyObject *args = Py_BuildValue("(s)", json.c_str());
+  PyObject *r = CallShim("symbol_create_from_json", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(symbol));
+  PyObject *r = CallShim("symbol_save_to_json", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  scratch.json = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out_json = scratch.json.c_str();
+  API_END();
+}
+
+int MXSymbolFree(SymbolHandle symbol) {
+  API_BEGIN();
+  Py_XDECREF(reinterpret_cast<PyObject *>(symbol));
+  API_END();
+}
+
+static int SymbolStrList(const char *fn, SymbolHandle symbol,
+                         mx_uint *out_size, const char ***out_array) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(symbol));
+  PyObject *r = CallShim(fn, args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  StrListOut(r, out_size, out_array);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                          const char ***out_array) {
+  return SymbolStrList("symbol_list_arguments", symbol, out_size, out_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                        const char ***out_array) {
+  return SymbolStrList("symbol_list_outputs", symbol, out_size, out_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
+                                const char ***out_array) {
+  return SymbolStrList("symbol_list_auxiliary_states", symbol, out_size,
+                       out_array);
+}
+
+/* --------------------------------------------------------------- Predictor */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  API_BEGIN();
+  PyObject *names = PyTuple_New(num_input_nodes);
+  PyObject *shapes = PyTuple_New(num_input_nodes);
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    PyTuple_SET_ITEM(names, i, PyUnicode_FromString(input_keys[i]));
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyTuple_SET_ITEM(shapes, i, ShapeTuple(input_shape_data + lo, hi - lo));
+  }
+  PyObject *blob = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(param_bytes), param_size);
+  PyObject *args = Py_BuildValue("(sNiiNN)", symbol_json_str, blob, dev_type,
+                                 dev_id, names, shapes);
+  PyObject *r = CallShim("pred_create", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size) {
+  API_BEGIN();
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data), size * sizeof(mx_float));
+  PyObject *args = Py_BuildValue("(OsN)",
+                                 reinterpret_cast<PyObject *>(handle), key,
+                                 bytes);
+  PyObject *r = CallShim("pred_set_input", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXPredForward(PredictorHandle handle) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("pred_forward", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(OI)",
+                                 reinterpret_cast<PyObject *>(handle), index);
+  PyObject *r = CallShim("pred_get_output_shape", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_ssize_t n = PyTuple_Size(r);
+  scratch.shape.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    scratch.shape.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(r, i))));
+  }
+  Py_DECREF(r);
+  *shape_ndim = static_cast<mx_uint>(n);
+  *shape_data = scratch.shape.data();
+  API_END();
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(OI)",
+                                 reinterpret_cast<PyObject *>(handle), index);
+  PyObject *r = CallShim("pred_get_output", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(r, &buf, &len);
+  size_t want = size * sizeof(mx_float);
+  std::memcpy(data, buf, len < static_cast<Py_ssize_t>(want)
+                             ? static_cast<size_t>(len) : want);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXPredFree(PredictorHandle handle) {
+  API_BEGIN();
+  Py_XDECREF(reinterpret_cast<PyObject *>(handle));
+  API_END();
+}
+
+}  // extern "C"

@@ -1,0 +1,192 @@
+"""Native C API + cpp-package tests (parity model: the reference's C API is
+exercised implicitly by every frontend; here we drive libmxnet_tpu.so
+directly via ctypes and run the cpp-package example binary end to end)."""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", "..", ".."))
+BUILD = os.path.join(REPO, "build")
+LIB = os.path.join(BUILD, "libmxnet_tpu.so")
+EXAMPLE = os.path.join(BUILD, "mlp_predict")
+
+
+@pytest.fixture(scope="module")
+def libmx():
+    if not os.path.exists(LIB):
+        subprocess.run(["cmake", "-S", REPO, "-B", BUILD, "-G", "Ninja",
+                        "-DCMAKE_BUILD_TYPE=Release"], check=True,
+                       capture_output=True)
+        subprocess.run(["ninja", "-C", BUILD], check=True,
+                       capture_output=True)
+    lib = ctypes.CDLL(LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    assert lib.MXTPULibInit() == 0, "library init failed"
+    return lib
+
+
+def _check(lib, rc):
+    assert rc == 0, lib.MXGetLastError().decode()
+
+
+def test_ndarray_roundtrip(libmx):
+    shape = (ctypes.c_uint * 2)(3, 4)
+    handle = ctypes.c_void_p()
+    _check(libmx, libmx.MXNDArrayCreate(shape, 2, 1, 0, 0,
+                                        ctypes.byref(handle)))
+    data = np.arange(12, dtype=np.float32)
+    _check(libmx, libmx.MXNDArraySyncCopyFromCPU(
+        handle, data.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(12)))
+    out = np.zeros(12, dtype=np.float32)
+    _check(libmx, libmx.MXNDArraySyncCopyToCPU(
+        handle, out.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(12)))
+    np.testing.assert_array_equal(out, data)
+
+    ndim = ctypes.c_uint()
+    pdata = ctypes.POINTER(ctypes.c_uint)()
+    _check(libmx, libmx.MXNDArrayGetShape(handle, ctypes.byref(ndim),
+                                          ctypes.byref(pdata)))
+    assert ndim.value == 2 and pdata[0] == 3 and pdata[1] == 4
+    _check(libmx, libmx.MXNDArrayFree(handle))
+
+
+def test_ndarray_save_load(libmx, tmp_path):
+    fname = str(tmp_path / "arrs.params").encode()
+    shape = (ctypes.c_uint * 1)(5)
+    h = ctypes.c_void_p()
+    _check(libmx, libmx.MXNDArrayCreate(shape, 1, 1, 0, 0, ctypes.byref(h)))
+    vals = np.array([1, 2, 3, 4, 5], np.float32)
+    _check(libmx, libmx.MXNDArraySyncCopyFromCPU(
+        h, vals.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(5)))
+    handles = (ctypes.c_void_p * 1)(h)
+    keys = (ctypes.c_char_p * 1)(b"w")
+    _check(libmx, libmx.MXNDArraySave(fname, 1, handles, keys))
+
+    out_size = ctypes.c_uint()
+    out_arr = ctypes.POINTER(ctypes.c_void_p)()
+    name_size = ctypes.c_uint()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    _check(libmx, libmx.MXNDArrayLoad(fname, ctypes.byref(out_size),
+                                      ctypes.byref(out_arr),
+                                      ctypes.byref(name_size),
+                                      ctypes.byref(names)))
+    assert out_size.value == 1 and name_size.value == 1
+    assert names[0] == b"w"
+    got = np.zeros(5, np.float32)
+    _check(libmx, libmx.MXNDArraySyncCopyToCPU(
+        ctypes.c_void_p(out_arr[0]), got.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_size_t(5)))
+    np.testing.assert_array_equal(got, vals)
+
+
+def test_list_ops_and_symbol_json(libmx):
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(libmx, libmx.MXListAllOpNames(ctypes.byref(n), ctypes.byref(arr)))
+    ops = {arr[i].decode() for i in range(n.value)}
+    assert n.value > 200
+    assert {"FullyConnected", "Convolution",
+            "dot_product_attention"} <= ops
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    json_str = net.tojson().encode()
+    h = ctypes.c_void_p()
+    _check(libmx, libmx.MXSymbolCreateFromJSON(json_str, ctypes.byref(h)))
+    ns = ctypes.c_uint()
+    sarr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(libmx, libmx.MXSymbolListArguments(h, ctypes.byref(ns),
+                                              ctypes.byref(sarr)))
+    args = [sarr[i].decode() for i in range(ns.value)]
+    assert args == ["data", "fc_weight", "fc_bias"]
+    out_json = ctypes.c_char_p()
+    _check(libmx, libmx.MXSymbolSaveToJSON(h, ctypes.byref(out_json)))
+    assert b"fc_weight" in out_json.value
+    _check(libmx, libmx.MXSymbolFree(h))
+
+
+def test_error_reporting(libmx):
+    h = ctypes.c_void_p()
+    rc = libmx.MXSymbolCreateFromJSON(b"{not json", ctypes.byref(h))
+    assert rc == -1
+    assert len(libmx.MXGetLastError()) > 0
+
+
+def _train_tiny_mlp(prefix):
+    rng = np.random.RandomState(0)
+    centers = rng.randn(4, 32) * 3
+    y = rng.randint(0, 4, 200)
+    x = (centers[y] + rng.randn(200, 32)).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y.astype(np.float32), batch_size=25)
+    from mxnet_tpu import models
+    mod = mx.Module(models.get_mlp(num_classes=4), context=mx.cpu())
+    mod.fit(it, num_epoch=10,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    mod.save_checkpoint(prefix, 4)
+    return mod
+
+
+def test_c_predict_api(libmx, tmp_path):
+    prefix = str(tmp_path / "mlp")
+    mod = _train_tiny_mlp(prefix)
+
+    with open(prefix + "-symbol.json", "rb") as f:
+        sym_json = f.read()
+    with open(prefix + "-0004.params", "rb") as f:
+        params = f.read()
+    batch, dim = 3, 32
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    shapes = (ctypes.c_uint * 2)(batch, dim)
+    pred = ctypes.c_void_p()
+    _check(libmx, libmx.MXPredCreate(
+        sym_json, params, len(params), 1, 0, 1, keys, indptr, shapes,
+        ctypes.byref(pred)))
+
+    x = np.linspace(-1, 1, batch * dim).astype(np.float32)
+    _check(libmx, libmx.MXPredSetInput(
+        pred, b"data", x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint(x.size)))
+    _check(libmx, libmx.MXPredForward(pred))
+    sd = ctypes.POINTER(ctypes.c_uint)()
+    nd_ = ctypes.c_uint()
+    _check(libmx, libmx.MXPredGetOutputShape(pred, 0, ctypes.byref(sd),
+                                             ctypes.byref(nd_)))
+    shape = tuple(sd[i] for i in range(nd_.value))
+    assert shape == (batch, 4)
+    out = np.zeros(batch * 4, np.float32)
+    _check(libmx, libmx.MXPredGetOutput(
+        pred, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint(out.size)))
+    _check(libmx, libmx.MXPredFree(pred))
+
+    # must match the Python predictor numerically
+    from mxnet_tpu.predictor import Predictor
+    py_pred = Predictor.from_checkpoint(prefix, 4,
+                                        {"data": (batch, dim)})
+    py_pred.set_input("data", x.reshape(batch, dim))
+    py_pred.forward()
+    np.testing.assert_allclose(out.reshape(batch, 4),
+                               py_pred.get_output(0), rtol=1e-5)
+
+
+def test_cpp_example_binary(libmx, tmp_path):
+    """The cpp-package example runs standalone (its own embedded runtime)."""
+    if not os.path.exists(EXAMPLE):
+        pytest.skip("example binary not built")
+    prefix = str(tmp_path / "mlp")
+    _train_tiny_mlp(prefix)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    res = subprocess.run([EXAMPLE, prefix, "4", "3", "32"],
+                         capture_output=True, text=True, env=env,
+                         timeout=240)
+    assert res.returncode == 0, res.stderr
+    assert "output shape: (3, 4)" in res.stdout
+    assert res.stdout.count("argmax") == 3
