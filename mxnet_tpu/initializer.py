@@ -17,7 +17,7 @@ from . import random as _random
 
 __all__ = ["Initializer", "Uniform", "Normal", "Orthogonal", "Xavier",
            "MSRAPrelu", "Bilinear", "One", "Zero", "Constant", "Load", "Mixed",
-           "InitDesc"]
+           "FusedRNN", "LSTMBias", "InitDesc"]
 
 
 class InitDesc(str):
@@ -267,6 +267,63 @@ class MSRAPrelu(Xavier):
 class Bilinear(Initializer):
     def _init_weight(self, name, arr):
         self._init_bilinear(name, arr)
+
+
+class FusedRNN(Initializer):
+    """Initialize a FusedRNNCell's flat parameter vector by unpacking it,
+    applying ``init`` per weight (forget-gate biases to ``forget_bias``),
+    and re-packing (parity: reference initializer.py FusedRNN:448-496)."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if init is None:
+            raise MXNetError("FusedRNN requires an inner initializer")
+        if not isinstance(init, Initializer):
+            klass, kwargs = json.loads(init)
+            init = _INITIALIZER_REGISTRY[klass.lower()](**kwargs)
+        super().__init__(init=init.dumps(),
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _infer_input_size(self, total):
+        """Solve the input size from the flat parameter count."""
+        h = self._num_hidden
+        d = 2 if self._bidirectional else 1
+        g = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[self._mode]
+        rest = (self._num_layers - 1) * (h * d + h + 2) + h + 2
+        input_size = total // (d * g * h) - rest
+        if (input_size + rest) * d * g * h != total:
+            raise MXNetError("FusedRNN: cannot infer input size from "
+                             "%d parameters" % total)
+        return int(input_size)
+
+    def _init_weight(self, _, arr):
+        from .rnn import rnn_cell
+        cell = rnn_cell.FusedRNNCell(self._num_hidden, self._num_layers,
+                                     self._mode, self._bidirectional,
+                                     forget_bias=self._forget_bias,
+                                     prefix="")
+        cell._input_size_hint = self._infer_input_size(arr.size)
+        args = cell.unpack_weights({"parameters": arr})
+        h = self._num_hidden
+        for name in args:
+            if name.endswith("_bias"):
+                args[name][:] = 0.0
+                if self._mode == "lstm":
+                    # gate order i,f,c,o: the forget-gate slice gets the bias
+                    v = args[name].asnumpy().copy()
+                    v[h:2 * h] = self._forget_bias
+                    args[name][:] = v
+            else:
+                self._init(InitDesc(name), args[name])
+        arr[:] = cell.pack_weights(args)["parameters"]
 
 
 class LSTMBias(Initializer):

@@ -23,3 +23,5 @@ from . import contrib        # noqa: F401  (multibox_*, proposal, ctc_loss)
 from . import custom         # noqa: F401  (Custom — python callback op)
 from . import attention      # noqa: F401  (NEW: dot_product_attention/ring,
                              #  LayerNorm — no reference analogue, §5.7)
+from . import misc           # noqa: F401  (ndarray-fun registry tail,
+                             #  KL sparse reg, v1 aliases)

@@ -1,0 +1,150 @@
+"""Long-tail operators closing the reference registration inventory
+(parity: reference src/ndarray/ndarray.cc NDArray-function registry,
+src/operator/identity_attach_KL_sparse_reg.cc, slice-assign ops, and the
+v1 op aliases kept for old model JSON)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import OPS, register, parse_float, parse_int, parse_tuple
+
+
+@register("choose_element_0index", arg_names=("lhs", "rhs"),
+          infer_shape=lambda attrs, ins: (
+              list(ins), [None if ins[0] is None else (ins[0][0],)], None))
+def _choose_element_0index(lhs, rhs):
+    """out[i] = lhs[i, rhs[i]] (parity: ndarray.cc choose_element_0index —
+    used by RNN perplexity evaluation)."""
+    idx = rhs.astype(jnp.int32).reshape(-1)
+    return jnp.take_along_axis(lhs, idx[:, None], axis=1)[:, 0]
+
+
+@register("fill_element_0index", arg_names=("lhs", "mhs", "rhs"),
+          infer_shape=lambda attrs, ins: (list(ins), [ins[0]], None))
+def _fill_element_0index(lhs, mhs, rhs):
+    """out = lhs with out[i, rhs[i]] = mhs[i] (parity: ndarray.cc)."""
+    idx = rhs.astype(jnp.int32).reshape(-1)
+    rows = jnp.arange(lhs.shape[0])
+    return lhs.at[rows, idx].set(mhs.reshape(-1))
+
+
+@register("_broadcast", attr_types={"axis": parse_int, "size": parse_int},
+          defaults={"axis": 0, "size": 1})
+def _broadcast_fun(data, axis=0, size=1):
+    """Broadcast a size-1 axis to ``size`` (parity: ndarray.cc _broadcast)."""
+    shape = list(data.shape)
+    shape[axis] = int(size)
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+@register("_onehot_encode", arg_names=("lhs", "rhs"),
+          infer_shape=lambda attrs, ins: (list(ins), [ins[1]], None))
+def _onehot_encode_op(lhs, rhs):
+    """One-hot into the shape of rhs (parity: ndarray.cc _onehot_encode)."""
+    depth = rhs.shape[1]
+    return jax.nn.one_hot(lhs.astype(jnp.int32), depth, dtype=rhs.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _kl_sparse_fn(sparseness_target, penalty):
+    @jax.custom_vjp
+    def f(data, new_mavg):
+        return data
+
+    def fwd(data, new_mavg):
+        return data, new_mavg
+
+    def bwd(new_mavg, g):
+        # grad += penalty * d KL(target || mean_activation) / d activation
+        # (reference identity_attach_KL_sparse_reg-inl.h:88-92)
+        pen = penalty * (-sparseness_target / new_mavg
+                         + (1.0 - sparseness_target) / (1.0 - new_mavg))
+        gflat = g.reshape(g.shape[0], -1) + pen[None, :]
+        return gflat.reshape(g.shape), jnp.zeros_like(new_mavg)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def _kl_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, [None], [None]
+    # moving average is over the flattened feature dims (the op body uses
+    # FlatTo2D semantics like the reference)
+    import numpy as _np
+    feat = int(_np.prod(data[1:])) if len(data) > 1 else 1
+    return [data, (feat,)], [data], [(feat,)]
+
+
+@register("IdentityAttachKLSparseReg", arg_names=("data", "moving_avg"),
+          aux_names=("moving_avg",),
+          attr_types={"sparseness_target": parse_float,
+                      "penalty": parse_float, "momentum": parse_float},
+          defaults={"sparseness_target": 0.1, "penalty": 0.001,
+                    "momentum": 0.9},
+          infer_shape=_kl_infer, train_aware=True)
+def _identity_attach_kl_sparse_reg(data, moving_avg, is_train=False,
+                                   sparseness_target=0.1, penalty=0.001,
+                                   momentum=0.9):
+    """Identity forward; sparseness (KL) penalty added to the gradient, with
+    a moving average of mean activations as auxiliary state (parity:
+    identity_attach_KL_sparse_reg-inl.h; pair with sigmoid activations)."""
+    flat = data.reshape(data.shape[0], -1)
+    new_mavg = momentum * moving_avg + (1 - momentum) * flat.mean(axis=0)
+    out = _kl_sparse_fn(sparseness_target, penalty)(data, new_mavg)
+    if is_train:
+        return out, new_mavg
+    return out, moving_avg
+
+
+@register("_CrossDeviceCopy", hidden=True)
+def _cross_device_copy(data):
+    """Placement boundary marker (parity: cross_device_copy.cc).  Device
+    transfers are inserted by the executor's ctx_group walk; under jit XLA
+    owns placement, so the op itself is identity."""
+    return data
+
+
+def _slice_ranges(attrs, shape):
+    begin = tuple(int(x) for x in attrs.get("begin", ()))
+    end = tuple(int(x) for x in attrs.get("end", ()))
+    out = []
+    for d in range(len(shape)):
+        b = begin[d] if d < len(begin) else 0
+        e = end[d] if d < len(end) and end[d] is not None else shape[d]
+        out.append(slice(b, e))
+    return tuple(out)
+
+
+@register("_slice_assign", aliases=("_crop_assign",),
+          arg_names=("lhs", "rhs"),
+          attr_types={"begin": parse_tuple, "end": parse_tuple},
+          defaults={"begin": (), "end": ()},
+          infer_shape=lambda attrs, ins: (list(ins), [ins[0]], None))
+def _slice_assign(lhs, rhs, begin=(), end=()):
+    """Functional slice assignment (parity: the reference's crop-assign;
+    TPU-natively an XLA dynamic-update-slice)."""
+    return lhs.at[_slice_ranges({"begin": begin, "end": end},
+                                lhs.shape)].set(rhs)
+
+
+@register("_crop_assign_scalar", arg_names=("data",),
+          attr_types={"begin": parse_tuple, "end": parse_tuple,
+                      "scalar": parse_float},
+          defaults={"begin": (), "end": (), "scalar": 0.0},
+          infer_shape=lambda attrs, ins: (list(ins), [ins[0]], None))
+def _crop_assign_scalar(data, begin=(), end=(), scalar=0.0):
+    return data.at[_slice_ranges({"begin": begin, "end": end},
+                                 data.shape)].set(scalar)
+
+
+# v1 aliases kept so old model JSON binds (parity: convolution_v1.cc,
+# pooling_v1.cc register the same compute under the legacy name)
+for _v1, _base in (("Convolution_v1", "Convolution"),
+                   ("Pooling_v1", "Pooling")):
+    if OPS.find(_v1) is None:
+        OPS.register(_v1, OPS.get(_base))

@@ -123,6 +123,10 @@ def _load_params(param_blob):
             os.unlink(path)
     else:
         raw = nd.load(param_blob)
+    if not isinstance(raw, dict):
+        raise MXNetError(
+            "Predictor params must be name-keyed ('arg:name'/'aux:name', "
+            "as written by save_checkpoint); got a positional array list")
     arg_params, aux_params = {}, {}
     for k, v in raw.items():
         if k.startswith("arg:"):

@@ -327,7 +327,8 @@ class FusedRNNCell(BaseRNNCell):
 
     def __init__(self, num_hidden, num_layers=1, mode="lstm",
                  bidirectional=False, dropout=0.0, get_next_state=False,
-                 forget_bias=1.0, prefix=None, params=None):
+                 forget_bias=1.0, prefix=None, params=None,
+                 initializer=None):
         if prefix is None:
             prefix = "%s_" % mode
         super().__init__(prefix=prefix, params=params)
@@ -338,7 +339,16 @@ class FusedRNNCell(BaseRNNCell):
         self._dropout = dropout
         self._get_next_state = get_next_state
         self._directions = ["l", "r"] if bidirectional else ["l"]
-        self._parameter = self.params.get("parameters")
+        # the flat parameter vector initialises by unpack->init->pack
+        # (parity: reference rnn_cell.py:506-511 attaching init.FusedRNN)
+        from .. import initializer as init_mod
+        if initializer is None:
+            initializer = init_mod.Xavier(factor_type="in", magnitude=2.34)
+        if not isinstance(initializer, init_mod.FusedRNN):
+            initializer = init_mod.FusedRNN(initializer, num_hidden,
+                                            num_layers, mode, bidirectional,
+                                            forget_bias)
+        self._parameter = self.params.get("parameters", init=initializer)
 
     @property
     def state_shape(self):

@@ -41,6 +41,9 @@ thread_local ThreadLocalScratch scratch;
 std::once_flag init_flag;
 PyObject *capi_module = nullptr;          // mxnet_tpu.capi
 PyThreadState *main_tstate = nullptr;
+std::string init_error;                   // import failure diagnostic
+
+std::string FetchPyError();
 
 void EnsureRuntime() {
   std::call_once(init_flag, []() {
@@ -53,7 +56,8 @@ void EnsureRuntime() {
     PyGILState_STATE g = PyGILState_Ensure();
     capi_module = PyImport_ImportModule("mxnet_tpu.capi");
     if (capi_module == nullptr) {
-      PyErr_Print();
+      init_error = "cannot import mxnet_tpu.capi (is mxnet_tpu on "
+                   "PYTHONPATH?): " + FetchPyError();
     }
     PyGILState_Release(g);
   });
@@ -91,7 +95,9 @@ class GILGuard {
  * pending).  The GIL must be held. */
 PyObject *CallShim(const char *fn, PyObject *args) {
   if (capi_module == nullptr) {
-    PyErr_SetString(PyExc_RuntimeError, "mxnet_tpu.capi failed to import");
+    PyErr_SetString(PyExc_RuntimeError, init_error.empty()
+                        ? "mxnet_tpu.capi failed to import"
+                        : init_error.c_str());
     return nullptr;
   }
   PyObject *f = PyObject_GetAttrString(capi_module, fn);
@@ -148,7 +154,11 @@ const char *MXGetLastError() { return last_error.c_str(); }
 int MXTPULibInit() {
   EnsureRuntime();
   GILGuard gil;
-  return capi_module != nullptr ? 0 : -1;
+  if (capi_module == nullptr) {
+    last_error = init_error;
+    return -1;
+  }
+  return 0;
 }
 
 int MXNotifyShutdown() {
@@ -213,8 +223,14 @@ int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
   Py_ssize_t len = 0;
   PyBytes_AsStringAndSize(r, &buf, &len);
   size_t want = size * sizeof(mx_float);
-  std::memcpy(data, buf, len < static_cast<Py_ssize_t>(want)
-                             ? static_cast<size_t>(len) : want);
+  if (static_cast<size_t>(len) != want) {
+    Py_DECREF(r);
+    last_error = "MXNDArraySyncCopyToCPU: size mismatch (array has " +
+                 std::to_string(len / sizeof(mx_float)) +
+                 " elements, caller passed " + std::to_string(size) + ")";
+    return -1;
+  }
+  std::memcpy(data, buf, want);
   Py_DECREF(r);
   API_END();
 }
@@ -463,8 +479,14 @@ int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
   Py_ssize_t len = 0;
   PyBytes_AsStringAndSize(r, &buf, &len);
   size_t want = size * sizeof(mx_float);
-  std::memcpy(data, buf, len < static_cast<Py_ssize_t>(want)
-                             ? static_cast<size_t>(len) : want);
+  if (static_cast<size_t>(len) != want) {
+    Py_DECREF(r);
+    last_error = "MXPredGetOutput: size mismatch (output has " +
+                 std::to_string(len / sizeof(mx_float)) +
+                 " elements, caller passed " + std::to_string(size) + ")";
+    return -1;
+  }
+  std::memcpy(data, buf, want);
   Py_DECREF(r);
   API_END();
 }

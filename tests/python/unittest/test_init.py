@@ -126,3 +126,24 @@ def test_initializer_dumps_roundtrip():
     klass, kwargs = json.loads(s)
     assert klass == "xavier"
     assert kwargs["magnitude"] == 2
+
+
+def test_fused_rnn_initializer():
+    """FusedRNN unpack->init->pack with forget-gate bias (parity:
+    reference initializer.py FusedRNN:448-496)."""
+    from mxnet_tpu.rnn.rnn_cell import FusedRNNCell
+    cell = FusedRNNCell(8, num_layers=2, mode="lstm", prefix="f_",
+                        forget_bias=2.0)
+    net, _ = cell.unroll(3, mx.sym.Variable("data"), layout="NTC",
+                         merge_outputs=True)
+    mod = mx.Module(mx.sym.MakeLoss(mx.sym.sum(net)), label_names=None,
+                    context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 3, 5))])
+    mod.init_params()
+    arr = mod.get_params()[0]["f_parameters"]
+    cell._input_size_hint = 5
+    unpacked = cell.unpack_weights({"f_parameters": arr})
+    fb = unpacked["f_l0_i2h_bias"].asnumpy()
+    np.testing.assert_allclose(fb[8:16], 2.0)
+    np.testing.assert_allclose(fb[:8], 0.0)
+    assert abs(unpacked["f_l0_i2h_weight"].asnumpy()).std() > 0

@@ -535,3 +535,58 @@ def test_check_consistency_conv():
     sym = mx.sym.Convolution(data, kernel=(3, 3), num_filter=2, name="c")
     check_consistency(sym, [{"ctx": mx.cpu(0), "data": (2, 3, 8, 8)},
                             {"ctx": mx.cpu(3), "data": (2, 3, 8, 8)}])
+
+
+def test_choose_fill_element_0index():
+    """(parity: reference ndarray.cc choose/fill_element_0index)"""
+    a = mx.nd.array(np.arange(12).reshape(3, 4).astype(np.float32))
+    idx = mx.nd.array(np.array([1, 0, 3], np.float32))
+    picked = mx.nd.choose_element_0index(a, idx).asnumpy()
+    np.testing.assert_array_equal(picked, [1, 4, 11])
+    filled = mx.nd.fill_element_0index(
+        a, mx.nd.array([9.0, 9.0, 9.0]), idx).asnumpy()
+    assert filled[0, 1] == 9 and filled[1, 0] == 9 and filled[2, 3] == 9
+    # untouched entries preserved
+    assert filled[0, 0] == 0 and filled[2, 2] == 10
+
+
+def test_broadcast_fun_and_slice_assign():
+    b = mx.nd.ones((1, 4))
+    out = mx.nd._broadcast(b, axis=0, size=3)
+    assert out.shape == (3, 4)
+    base = mx.nd.zeros((4, 4))
+    patch = mx.nd.ones((2, 2))
+    res = mx.nd._slice_assign(base, patch, begin=(1, 1), end=(3, 3))
+    v = res.asnumpy()
+    assert v[1:3, 1:3].sum() == 4 and v.sum() == 4
+    res2 = mx.nd._crop_assign_scalar(base, begin=(0, 0), end=(2, 2),
+                                     scalar=5.0)
+    assert res2.asnumpy()[:2, :2].sum() == 20
+
+
+def test_identity_attach_kl_sparse_reg():
+    """Forward identity; backward adds the KL sparseness penalty computed
+    from the updated moving average (parity:
+    identity_attach_KL_sparse_reg-inl.h)."""
+    rng = RS(0)
+    x = rng.rand(6, 5).astype(np.float32) * 0.8 + 0.1
+    data = mx.sym.Variable("data")
+    net = mx.sym.IdentityAttachKLSparseReg(
+        data, sparseness_target=0.2, penalty=0.1, momentum=0.0, name="kl")
+    ex = net.simple_bind(mx.cpu(), data=x.shape, grad_req="write")
+    ex.arg_dict["data"][:] = x
+    out = ex.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-6)  # identity forward
+    ex.backward([mx.nd.ones(x.shape)])
+    mavg = x.mean(axis=0)  # momentum=0 -> moving avg == batch mean
+    want = 1.0 + 0.1 * (-0.2 / mavg + 0.8 / (1 - mavg))
+    got = ex.grad_dict["data"].asnumpy()
+    np.testing.assert_allclose(got, np.broadcast_to(want, x.shape),
+                               rtol=1e-4)
+
+
+def test_v1_op_aliases():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution_v1(data, num_filter=2, kernel=(3, 3), name="c")
+    ex = c.simple_bind(mx.cpu(), data=(1, 1, 8, 8))
+    assert ex.forward()[0].shape == (1, 2, 6, 6)
