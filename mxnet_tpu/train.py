@@ -82,25 +82,27 @@ class _FunctionalOptimizer(object):
 
     # ------------------------------------------------------------------ hyper
     def hyper(self, num_update):
-        """Per-step traced scalars (host-computed, fed as jnp scalars)."""
+        """Traced scalars computed host-side per call (the lr *schedule* is
+        sampled here; Adam's per-step bias correction is computed on-device
+        from the traced step count so fused multi-step chunks stay exact)."""
         o = self.opt
         lr = o.lr
         if getattr(o, "lr_scheduler", None) is not None:
             lr = o.lr_scheduler(num_update)
-        h = {"lr": _np.float32(lr)}
-        if self.kind == "adam":
-            t = num_update + 1
-            coef1 = 1.0 - o.beta1 ** t
-            coef2 = 1.0 - o.beta2 ** t
-            h["lr"] = _np.float32(lr * (coef2 ** 0.5) / coef1)
-        return h
+        return {"lr": _np.float32(lr)}
 
     # ----------------------------------------------------------------- update
-    def update(self, name, w, g, state, hyper):
+    def update(self, name, w, g, state, hyper, t):
+        """One optimizer step; ``t`` is the 1-based traced update count."""
         import jax.numpy as jnp
         from .ops.registry import OPS
         o = self.opt
         lr = hyper["lr"] * self.lr_mult[name]
+        if self.kind == "adam":
+            tf = jnp.asarray(t, jnp.float32)
+            coef1 = 1.0 - o.beta1 ** tf
+            coef2 = 1.0 - o.beta2 ** tf
+            lr = lr * jnp.sqrt(coef2) / coef1
         wd = o.wd * self.wd_mult[name]
         clip = -1.0 if o.clip_gradient is None else o.clip_gradient
         common = dict(lr=lr, wd=wd, rescale_grad=o.rescale_grad,
@@ -209,7 +211,7 @@ class TrainStep(object):
                 policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
             fwd = jax.checkpoint(fwd, policy=policy)
 
-        def step(params, opt_state, aux, batch, rng, hyper):
+        def step(params, opt_state, aux, batch, rng, hyper, t):
             import jax.numpy as jnp
 
             def f(p):
@@ -221,12 +223,15 @@ class TrainStep(object):
             for n in self.param_names:
                 g = grads[n].astype(params[n].dtype)
                 new_params[n], new_state[n] = self.fopt.update(
-                    n, params[n], g, opt_state[n], hyper)
+                    n, params[n], g, opt_state[n], hyper, t)
             new_aux = dict(aux)
             new_aux.update({k: v.astype(aux[k].dtype)
                             for k, v in aux_upd.items() if k in aux})
             return new_params, new_state, new_aux, outs
 
+        self._step_fn = step
+        self._multi_cache = {}
+        self._in_shardings = None
         if mesh is not None:
             from jax.sharding import NamedSharding
             ps = dict(param_shardings or {})
@@ -237,9 +242,11 @@ class TrainStep(object):
             param_sh = {n: par_shard(n) for n in self.param_names}
             batch_sh = {n: NamedSharding(mesh, _pspec("dp"))
                         for n in inputs}
+            self._in_shardings = (param_sh, None, None, batch_sh, rep, None,
+                                  None)
             self._step = jax.jit(
                 step,
-                in_shardings=(param_sh, None, None, batch_sh, rep, None),
+                in_shardings=self._in_shardings,
                 donate_argnums=(0, 1, 2))
         else:
             self._step = jax.jit(step, donate_argnums=(0, 1, 2))
@@ -319,6 +326,68 @@ class TrainStep(object):
         sh = NamedSharding(self.mesh, _pspec("dp"))
         return {k: jax.device_put(v, sh) for k, v in batch.items()}
 
+    # ------------------------------------------------------------- multi-step
+    def run_steps(self, params, opt_state, aux, batch, num_steps, rng=None,
+                  stacked=False):
+        """Run ``num_steps + 1`` fused update steps as ONE XLA program
+        (lax.scan over the step body) — the TPU-idiomatic training loop: no
+        host dispatch between steps, weights never leave HBM.
+
+        Data semantics — choose explicitly:
+        - ``stacked=False`` (default): ``batch`` is ONE minibatch applied to
+          every step.  That is full-batch training / benchmarking; it is NOT
+          one-update-per-minibatch SGD.
+        - ``stacked=True``: every leaf of ``batch`` has a leading
+          ``num_steps + 1`` axis; step i consumes slice i (stage your loader
+          output with ``np.stack``), giving exact minibatch-SGD semantics.
+
+        The lr *schedule* is sampled once per chunk (host-side); Adam's
+        bias correction advances per step on-device, so results match
+        sequential stepping exactly.  Returns (params, opt_state, aux,
+        last_outputs)."""
+        import jax
+        if rng is None:
+            rng = _random.next_key()
+        hyper = self.fopt.hyper(self.num_update)
+        t0 = self.num_update
+        self.num_update += num_steps + 1
+        fn = self._multi_cache.get((num_steps, stacked))
+        if fn is None:
+            step = self._step_fn
+
+            def many(params, opt_state, aux, batch, rng, hyper, t0):
+                def body(carry, i):
+                    p, s, a = carry
+                    sub = jax.random.fold_in(rng, i)
+                    b = jax.tree_util.tree_map(lambda x: x[i], batch) \
+                        if stacked else batch
+                    p, s, a, outs = step(p, s, a, b, sub, hyper, t0 + i + 1)
+                    return (p, s, a), None
+                (p, s, a), _ = jax.lax.scan(
+                    body, (params, opt_state, aux),
+                    jax.numpy.arange(num_steps))
+                # one extra step emitting outputs (keeps scan carry lean)
+                last = jax.tree_util.tree_map(lambda x: x[num_steps], batch) \
+                    if stacked else batch
+                return step(p, s, a, last, rng, hyper, t0 + num_steps + 1)
+
+            if self.mesh is not None:
+                shardings = self._in_shardings
+                if stacked:
+                    # batch leaves carry a leading step axis; dp shards axis 1
+                    from jax.sharding import NamedSharding
+                    batch_sh = {n: NamedSharding(self.mesh,
+                                                 _pspec(None, "dp"))
+                                for n in shardings[3]}
+                    shardings = shardings[:3] + (batch_sh,) + shardings[4:]
+                fn = jax.jit(many, in_shardings=shardings,
+                             donate_argnums=(0, 1, 2))
+            else:
+                fn = jax.jit(many, donate_argnums=(0, 1, 2))
+            self._multi_cache[(num_steps, stacked)] = fn
+        return fn(params, opt_state, aux, batch, rng, hyper,
+                  _np.int32(t0))
+
     # ------------------------------------------------------------------- call
     def __call__(self, params, opt_state, aux, batch, rng=None):
         """One fused step.  Returns (params, opt_state, aux, outputs)."""
@@ -328,7 +397,8 @@ class TrainStep(object):
         hyper = self.fopt.hyper(self.num_update)
         self.num_update += 1
         with _profiler.Scope("train_step[%d]" % self.num_update, "symbolic"):
-            res = self._step(params, opt_state, aux, batch, rng, hyper)
+            res = self._step(params, opt_state, aux, batch, rng, hyper,
+                             _np.int32(self.num_update))
             if _profiler.is_running():
                 import jax
                 jax.block_until_ready(res[3])

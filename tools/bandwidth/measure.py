@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""KVStore bandwidth benchmark (parity: reference tools/bandwidth/measure.py
+— push model-sized gradients, pull weights, report GB/s per kvstore type).
+
+On TPU the interesting numbers are the device<->device reduce path
+(kvstore 'device' over the local mesh) and the cross-process allreduce
+('dist_tpu' over ICI/DCN); run the latter under tools/launch.py.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--network", type=str, default="resnet")
+    p.add_argument("--num-layers", type=int, default=50)
+    p.add_argument("--kv-store", type=str, default="device")
+    p.add_argument("--num-batches", type=int, default=5)
+    p.add_argument("--test-results", type=int, default=1)
+    p.add_argument("--image-shape", type=str, default="3,224,224")
+    p.add_argument("--num-devices", type=int, default=0,
+                   help="0 = all local devices")
+    return p.parse_args()
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    args = parse_args()
+    net_mod = getattr(models, args.network)
+    kwargs = {"num_classes": 1000, "image_shape": args.image_shape}
+    if args.network == "resnet":
+        kwargs["num_layers"] = args.num_layers
+    sym = net_mod.get_symbol(**kwargs)
+    arg_shapes, _, _ = sym.infer_shape(
+        data=(32,) + tuple(int(x) for x in args.image_shape.split(",")),
+        softmax_label=(32,))
+    names = sym.list_arguments()
+    shapes = [s for n, s in zip(names, arg_shapes)
+              if n not in ("data", "softmax_label")]
+
+    kv = mx.kvstore.create(args.kv_store)
+    import jax
+    ndev = args.num_devices or jax.local_device_count()
+    grads = []
+    weights = []
+    total_bytes = 0
+    rng = np.random.RandomState(0)
+    for i, s in enumerate(shapes):
+        kv.init(i, mx.nd.zeros(s))
+        grads.append([mx.nd.array(rng.rand(*s) * (d + 1))
+                      for d in range(ndev)])
+        weights.append([mx.nd.zeros(s) for _ in range(ndev)])
+        total_bytes += int(np.prod(s)) * 4
+
+    logging.info("%d tensors, %.1f MB per push x %d devices, kvstore=%s",
+                 len(shapes), total_bytes / 1e6, ndev, args.kv_store)
+    times = []
+    for b in range(args.num_batches):
+        t0 = time.perf_counter()
+        for i in range(len(shapes)):
+            kv.push(i, grads[i])
+        for i in range(len(shapes)):
+            kv.pull(i, out=weights[i])
+        for w in weights[-1]:
+            w.asnumpy()
+        times.append(time.perf_counter() - t0)
+        if args.test_results and b == 0:
+            want = sum(np.asarray(g.asnumpy(), np.float64)
+                       for g in grads[0])
+            got = weights[0][0].asnumpy()
+            np.testing.assert_allclose(got, want, rtol=1e-4)
+    per = min(times)
+    # push reduces ndev copies, pull broadcasts ndev copies
+    moved = total_bytes * ndev * 2
+    logging.info("best batch: %.3f s -> %.2f GB/s", per, moved / per / 1e9)
+
+
+if __name__ == "__main__":
+    main()
