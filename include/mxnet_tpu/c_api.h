@@ -71,6 +71,21 @@ MXNET_DLL int MXSymbolListAuxiliaryStates(SymbolHandle symbol,
                                           mx_uint *out_size,
                                           const char ***out_str_array);
 
+/* -------------------------------------------------------------- RecordIO */
+typedef void *RecordIOHandle;
+
+MXNET_DLL int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+MXNET_DLL int MXRecordIOWriterFree(RecordIOHandle handle);
+MXNET_DLL int MXRecordIOWriterWriteRecord(RecordIOHandle handle,
+                                          const char *buf, size_t size);
+MXNET_DLL int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos);
+MXNET_DLL int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+MXNET_DLL int MXRecordIOReaderFree(RecordIOHandle handle);
+/*! \brief read next record; *size == 0 at end of file */
+MXNET_DLL int MXRecordIOReaderReadRecord(RecordIOHandle handle,
+                                         const char **buf, size_t *size);
+MXNET_DLL int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos);
+
 #ifdef __cplusplus
 }
 #endif

@@ -147,3 +147,35 @@ def pred_get_output(pred, index):
 # ------------------------------------------------------------------- random
 def random_seed(seed):
     _random.seed(int(seed))
+
+
+# ------------------------------------------------------------------ recordio
+def recordio_writer_create(uri):
+    from .recordio import MXRecordIO
+    return MXRecordIO(uri, "w")
+
+
+def recordio_writer_write(handle, data):
+    handle.write(bytes(data))
+
+
+def recordio_tell(handle):
+    return int(handle.tell())
+
+
+def recordio_reader_create(uri):
+    from .recordio import MXRecordIO
+    return MXRecordIO(uri, "r")
+
+
+def recordio_reader_read(handle):
+    rec = handle.read()
+    return b"" if rec is None else rec
+
+
+def recordio_reader_seek(handle, pos):
+    handle.seek(int(pos))
+
+
+def recordio_close(handle):
+    handle.close()

@@ -346,6 +346,13 @@ class TrainStep(object):
         sequential stepping exactly.  Returns (params, opt_state, aux,
         last_outputs)."""
         import jax
+        if stacked:
+            for k, v in batch.items():
+                if v.shape[0] != num_steps + 1:
+                    raise MXNetError(
+                        "run_steps(stacked=True): %s has leading axis %d, "
+                        "need num_steps + 1 = %d (one minibatch per step)"
+                        % (k, v.shape[0], num_steps + 1))
         if rng is None:
             rng = _random.next_key()
         hyper = self.fopt.hyper(self.num_update)

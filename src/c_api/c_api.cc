@@ -397,6 +397,98 @@ int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
                        out_array);
 }
 
+/* ---------------------------------------------------------------- RecordIO */
+static int RecordIOCreate(const char *fn, const char *uri,
+                          RecordIOHandle *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(s)", uri);
+  PyObject *r = CallShim(fn, args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+static int RecordIOFree(RecordIOHandle handle) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("recordio_close", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  Py_XDECREF(reinterpret_cast<PyObject *>(handle));
+  API_END();
+}
+
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out) {
+  return RecordIOCreate("recordio_writer_create", uri, out);
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) {
+  return RecordIOFree(handle);
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size) {
+  API_BEGIN();
+  PyObject *bytes = PyBytes_FromStringAndSize(buf, size);
+  PyObject *args = Py_BuildValue("(ON)",
+                                 reinterpret_cast<PyObject *>(handle), bytes);
+  PyObject *r = CallShim("recordio_writer_write", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("recordio_tell", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *pos = static_cast<size_t>(PyLong_AsSize_t(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out) {
+  return RecordIOCreate("recordio_reader_create", uri, out);
+}
+
+int MXRecordIOReaderFree(RecordIOHandle handle) {
+  return RecordIOFree(handle);
+}
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **buf,
+                               size_t *size) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("recordio_reader_read", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  char *b = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(r, &b, &len);
+  scratch.json.assign(b, static_cast<size_t>(len));
+  Py_DECREF(r);
+  *buf = scratch.json.data();
+  *size = scratch.json.size();
+  API_END();
+}
+
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(On)",
+                                 reinterpret_cast<PyObject *>(handle),
+                                 static_cast<Py_ssize_t>(pos));
+  PyObject *r = CallShim("recordio_reader_seek", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
 /* --------------------------------------------------------------- Predictor */
 int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
                  int param_size, int dev_type, int dev_id,

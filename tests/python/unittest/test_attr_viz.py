@@ -1,0 +1,109 @@
+"""Symbol attribute + visualization tests (parity model: reference
+tests/python/unittest/test_attr.py + test_viz.py)."""
+import pickle as pkl
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_attr_basic():
+    with mx.AttrScope(group="4", data="great"):
+        data = mx.sym.Variable("data",
+                               attr={"dtype": "data", "group": "1",
+                                     "force_mirroring": "True"},
+                               lr_mult=1)
+        gdata = mx.sym.Variable("data2")
+    assert gdata.attr("group") == "4"
+    assert data.attr("group") == "1"
+    assert data.attr("__lr_mult__") == "1"
+    assert data.attr("force_mirroring") == "True"
+    data2 = pkl.loads(pkl.dumps(data))
+    assert data.attr("dtype") == data2.attr("dtype")
+
+
+def test_operator_attr_scope():
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(__group__="4", __data__="great"):
+        fc1 = mx.sym.Activation(data, act_type="relu")
+        with mx.AttrScope(__init_bias__="0.0"):
+            fc2 = mx.sym.FullyConnected(fc1, num_hidden=10, name="fc2")
+    assert fc1.attr("__data__") == "great"
+    assert fc2.attr("__data__") == "great"
+    assert fc2.attr("__init_bias__") == "0.0"
+    fc2copy = pkl.loads(pkl.dumps(fc2))
+    assert fc2copy.tojson() == fc2.tojson()
+    assert fc2.get_internals()["fc2_weight"] is not None
+
+
+def test_attr_dict():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    op = mx.sym.Convolution(data=data, name="conv", kernel=(1, 1),
+                            num_filter=1, attr={"__mood__": "so so"},
+                            lr_mult=1)
+    ad = op.attr_dict()
+    assert ad["data"]["mood"] == "angry"
+    assert ad["conv"]["__mood__"] == "so so"
+    assert ad["conv"]["__lr_mult__"] == "1"
+    # hidden-key inheritance: auto-created weight carries lr_mult
+    assert ad["conv_weight"]["__lr_mult__"] == "1"
+
+
+def test_attrs_survive_json():
+    with mx.AttrScope(ctx_group="dev1"):
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+    net2 = mx.sym.load_json(net.tojson())
+    assert net2.attr_dict()["fc"]["ctx_group"] == "dev1"
+
+
+def test_print_summary(capsys):
+    """(parity: test_viz.py test_print_summary)"""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mx.visualization.print_summary(net, shape={"data": (5, 10)})
+    out = capsys.readouterr().out
+    assert "fc1" in out and "fc2" in out
+    assert "Total params" in out or "params" in out.lower()
+
+
+def test_plot_network_graph_source():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    dot = mx.visualization.plot_network(net, shape={"data": (5, 10),
+                                                    "softmax_label": (5,)})
+    src = dot if isinstance(dot, str) else getattr(dot, "source", str(dot))
+    assert "fc1" in src
+
+
+def test_monitor_module_install():
+    """Monitor through Module.fit collects per-op stats from the single
+    real execution (parity: monitor.py usage in fit)."""
+    x = np.random.RandomState(0).rand(20, 6).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 3, 20).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=10)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                              name="fc"), name="softmax")
+    mon = mx.monitor.Monitor(1, stat_func=lambda d: mx.nd.norm(d),
+                             pattern=".*fc.*")
+    mod = mx.Module(net, context=mx.cpu())
+    # fit consumes stats via toc_print each batch; just assert it runs
+    mod.fit(it, num_epoch=1, monitor=mon,
+            optimizer_params={"learning_rate": 0.1})
+    # manual tic/forward/toc on a raw executor yields matching entries
+    ex = net.simple_bind(mx.cpu(), data=(10, 6), softmax_label=(10,))
+    mon2 = mx.monitor.Monitor(1, stat_func=lambda d: mx.nd.norm(d),
+                              pattern=".*fc.*")
+    mon2.install(ex)
+    mon2.tic()
+    ex.forward(is_train=True, data=mx.nd.array(x[:10]),
+               softmax_label=mx.nd.array(y[:10]))
+    entries = mon2.toc()
+    names = [t[1] for t in entries]
+    assert any("fc" in n for n in names), names

@@ -190,3 +190,33 @@ def test_cpp_example_binary(libmx, tmp_path):
     assert res.returncode == 0, res.stderr
     assert "output shape: (3, 4)" in res.stdout
     assert res.stdout.count("argmax") == 3
+
+
+def test_recordio_c_api(libmx, tmp_path):
+    """MXRecordIO* round-trip through the native boundary (parity:
+    reference c_api.h:1379-1437)."""
+    uri = str(tmp_path / "data.rec").encode()
+    w = ctypes.c_void_p()
+    _check(libmx, libmx.MXRecordIOWriterCreate(uri, ctypes.byref(w)))
+    payloads = [b"alpha", b"bravo" * 100, b"charlie"]
+    for p in payloads:
+        _check(libmx, libmx.MXRecordIOWriterWriteRecord(
+            w, p, ctypes.c_size_t(len(p))))
+    pos = ctypes.c_size_t()
+    _check(libmx, libmx.MXRecordIOWriterTell(w, ctypes.byref(pos)))
+    assert pos.value > 0
+    _check(libmx, libmx.MXRecordIOWriterFree(w))
+
+    r = ctypes.c_void_p()
+    _check(libmx, libmx.MXRecordIOReaderCreate(uri, ctypes.byref(r)))
+    got = []
+    while True:
+        buf = ctypes.c_char_p()
+        size = ctypes.c_size_t()
+        _check(libmx, libmx.MXRecordIOReaderReadRecord(
+            r, ctypes.byref(buf), ctypes.byref(size)))
+        if size.value == 0:
+            break
+        got.append(ctypes.string_at(buf, size.value))
+    assert got == payloads
+    _check(libmx, libmx.MXRecordIOReaderFree(r))

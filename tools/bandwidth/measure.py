@@ -54,15 +54,17 @@ def main():
     kv = mx.kvstore.create(args.kv_store)
     import jax
     ndev = args.num_devices or jax.local_device_count()
+    # one copy per DEVICE: the reduce must actually cross the interconnect
+    ctxs = [mx.tpu(d) for d in range(ndev)]
     grads = []
     weights = []
     total_bytes = 0
     rng = np.random.RandomState(0)
     for i, s in enumerate(shapes):
         kv.init(i, mx.nd.zeros(s))
-        grads.append([mx.nd.array(rng.rand(*s) * (d + 1))
+        grads.append([mx.nd.array(rng.rand(*s) * (d + 1), ctx=ctxs[d])
                       for d in range(ndev)])
-        weights.append([mx.nd.zeros(s) for _ in range(ndev)])
+        weights.append([mx.nd.zeros(s, ctx=ctxs[d]) for d in range(ndev)])
         total_bytes += int(np.prod(s)) * 4
 
     logging.info("%d tensors, %.1f MB per push x %d devices, kvstore=%s",
@@ -74,8 +76,10 @@ def main():
             kv.push(i, grads[i])
         for i in range(len(shapes)):
             kv.pull(i, out=weights[i])
-        for w in weights[-1]:
-            w.asnumpy()
+        # drain EVERY key's chain before stopping the clock
+        for wlist in weights:
+            for w in wlist:
+                w.asnumpy()
         times.append(time.perf_counter() - t0)
         if args.test_results and b == 0:
             want = sum(np.asarray(g.asnumpy(), np.float64)
