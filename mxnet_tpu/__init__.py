@@ -42,6 +42,7 @@ from . import recordio
 from . import profiler
 from . import engine
 from . import predictor
+from . import rtc
 from .predictor import Predictor
 from . import rnn
 from . import test_utils

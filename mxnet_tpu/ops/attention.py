@@ -25,19 +25,43 @@ def _attn_infer(attrs, in_shapes):
     return list(in_shapes), [q], None
 
 
+def _on_tpu():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 @register("dot_product_attention", arg_names=("query", "key", "value"),
-          attr_types={"causal": parse_bool, "scale": parse_float},
-          defaults={"causal": False, "scale": None},
+          attr_types={"causal": parse_bool, "scale": parse_float,
+                      "impl": str},
+          defaults={"causal": False, "scale": None, "impl": "auto"},
           infer_shape=_attn_infer)
-def _dot_product_attention(query, key, value, causal=False, scale=None):
-    """Scaled dot-product attention over (B, H, T, D); ring-parallel when a
-    sequence mesh is active."""
+def _dot_product_attention(query, key, value, causal=False, scale=None,
+                           impl="auto"):
+    """Scaled dot-product attention over (B, H, T, D).
+
+    Lowering ladder (impl='auto'):
+    1. sequence mesh active -> ring attention (multi-chip, ppermute ring);
+    2. TPU + flash-friendly shapes + T >= 512 -> Pallas flash kernel
+       (blocked online-softmax, no (T, T) score matrix; ~2x XLA attention
+       at long T on v5e);
+    3. otherwise -> the XLA reference expression (fused fine at short T).
+    ``impl`` forces 'flash' / 'xla' for testing."""
     from ..parallel import mesh as mesh_mod
     from ..parallel import ring
+    from . import pallas_kernels
     mesh, axis = mesh_mod.sequence_mesh()
     if mesh is not None:
         return ring.ring_attention(query, key, value, mesh, axis=axis,
                                    causal=causal, scale=scale)
+    use_flash = impl == "flash" or (
+        impl == "auto" and _on_tpu() and query.shape[2] >= 512
+        and pallas_kernels.flash_available(query.shape, key.shape,
+                                           value.shape))
+    if use_flash:
+        return pallas_kernels.flash_attention(query, key, value, causal,
+                                              scale)
     return ring.attention_reference(query, key, value, causal=causal,
                                     scale=scale)
 

@@ -1,0 +1,185 @@
+"""Pallas TPU kernels for the hot ops (SURVEY.md §7: "Pallas kernels where
+XLA fusion is insufficient").
+
+``flash_attention``: blocked attention forward that never materialises the
+(T, T) score matrix — Q tiles stay resident in VMEM while K/V blocks stream
+through, folded with the online-softmax recurrence (running max ``m``,
+normaliser ``l``, f32 accumulator).  The backward pass recomputes through
+the XLA reference expression under ``jax.custom_vjp`` (flash-style
+recompute: O(T) memory in both directions).
+
+Used by ``dot_product_attention`` (ops/attention.py) on TPU for long
+sequences; everything is shape-guarded so XLA's fused attention remains the
+fallback.  Tested in Pallas interpret mode on the CPU harness.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention", "flash_available"]
+
+_NEG_INF = -1e30
+
+
+def flash_available(q_shape, k_shape=None, v_shape=None, block_q=128,
+                    block_k=128):
+    """Shape guard: self-attention only (q/k/v shapes equal), T divisible
+    into blocks, D lane-friendly."""
+    if len(q_shape) != 4:
+        return False
+    for other in (k_shape, v_shape):
+        if other is not None and tuple(other) != tuple(q_shape):
+            return False  # cross-attention -> XLA path
+    t, d = q_shape[2], q_shape[3]
+    return t % block_q == 0 and t % block_k == 0 and t >= block_q and \
+        d % 8 == 0 and d <= 256
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
+                block_k, seq_len):
+    # refs carry one (bh) slice: q (1, block_q, D), k/v (1, T, D)
+    j = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # (bq, D)
+    bq, d = q.shape
+    q_pos = j * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    def fold(kb, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (bq, bk)
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        blk_max = jnp.max(s, axis=1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        p = jnp.exp(s - new_m)
+        corr = jnp.exp(m - new_m)
+        l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * corr + jax.lax.dot(p, v)
+        return acc, new_m, l
+
+    acc = jnp.zeros((bq, d), jnp.float32)
+    m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    if causal:
+        # blocks strictly above the diagonal contribute nothing; stop early
+        num_kb = (j + 1) * block_q // block_k
+    else:
+        num_kb = seq_len // block_k
+    acc, m, l = jax.lax.fori_loop(0, num_kb, fold, (acc, m, l))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+try:  # pallas import kept lazy-safe for exotic builds
+    from jax.experimental import pallas as pl
+except Exception:  # pragma: no cover
+    pl = None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128, interpret=False):
+    """Blocked attention over (B, H, T, D); same semantics as
+    ``attention_reference``."""
+    return _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                           interpret)
+
+
+def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, t, d = q.shape
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, t, d)
+    vf = v.reshape(b * h, t, d)
+    kernel = functools.partial(_fwd_kernel, scale=sc, causal=causal,
+                               block_q=block_q, block_k=block_k, seq_len=t)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+    return out, (q, k, v, out)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    """Blocked flash backward (pure XLA): recompute scores one K-block at a
+    time against the saved log-sum-exp, so the (T, T) matrix never
+    materialises in the backward either — O(T·block) live memory, matmuls
+    on the MXU."""
+    q, k, v, out = res
+    b, h, t, d = q.shape
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    q_pos = jnp.arange(t)[:, None]
+
+    # pass 1 (blocked): per-row log-sum-exp of the scaled scores
+    def lse_fold(kb, carry):
+        m, l = carry
+        kb_ = jax.lax.dynamic_slice_in_dim(k, kb * block_k, block_k, 2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf,
+                       kb_.astype(jnp.float32)) * sc
+        if causal:
+            k_pos = kb * block_k + jnp.arange(block_k)[None, :]
+            s = jnp.where((k_pos <= q_pos)[None, None], s, _NEG_INF)
+        bm = s.max(axis=-1, keepdims=True)
+        nm = jnp.maximum(m, bm)
+        l = l * jnp.exp(m - nm) + jnp.exp(s - nm).sum(axis=-1,
+                                                      keepdims=True)
+        return nm, l
+
+    nkb = t // block_k
+    m0 = jnp.full((b, h, t, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t, 1), jnp.float32)
+    m, l = jax.lax.fori_loop(0, nkb, lse_fold, (m0, l0))
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    dsum = (gf * out.astype(jnp.float32)).sum(axis=-1, keepdims=True)
+
+    # pass 2 (blocked): gradients per K-block
+    def grad_fold(kb, carry):
+        dq, dk, dv = carry
+        kb_ = jax.lax.dynamic_slice_in_dim(k, kb * block_k, block_k,
+                                           2).astype(jnp.float32)
+        vb_ = jax.lax.dynamic_slice_in_dim(v, kb * block_k, block_k,
+                                           2).astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb_) * sc
+        if causal:
+            k_pos = kb * block_k + jnp.arange(block_k)[None, :]
+            mask = (k_pos <= q_pos)[None, None]
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)                              # (b,h,t,bk)
+        dvb = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vb_)
+        ds = p * (dp - dsum) * sc
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kb_)
+        dkb = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        dk = jax.lax.dynamic_update_slice_in_dim(dk, dkb, kb * block_k, 2)
+        dv = jax.lax.dynamic_update_slice_in_dim(dv, dvb, kb * block_k, 2)
+        return dq, dk, dv
+
+    zeros = jnp.zeros((b, h, t, d), jnp.float32)
+    dq, dk, dv = jax.lax.fori_loop(0, nkb, grad_fold,
+                                   (zeros, zeros, zeros))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -431,6 +431,13 @@ int MXRecordIOWriterFree(RecordIOHandle handle) {
 int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
                                 size_t size) {
   API_BEGIN();
+  if (size == 0) {
+    // the read contract uses *size == 0 as end-of-stream, so a zero-length
+    // record would truncate every record after it on read
+    last_error = "MXRecordIOWriterWriteRecord: zero-length records are not "
+                 "representable through the C API";
+    return -1;
+  }
   PyObject *bytes = PyBytes_FromStringAndSize(buf, size);
   PyObject *args = Py_BuildValue("(ON)",
                                  reinterpret_cast<PyObject *>(handle), bytes);
@@ -469,7 +476,11 @@ int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **buf,
   CHECK_PY(r);
   char *b = nullptr;
   Py_ssize_t len = 0;
-  PyBytes_AsStringAndSize(r, &b, &len);
+  if (PyBytes_AsStringAndSize(r, &b, &len) != 0) {
+    Py_DECREF(r);
+    last_error = FetchPyError();
+    return -1;
+  }
   scratch.json.assign(b, static_cast<size_t>(len));
   Py_DECREF(r);
   *buf = scratch.json.data();
