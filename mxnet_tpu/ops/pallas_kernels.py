@@ -28,19 +28,23 @@ _NEG_INF = -1e30
 def flash_available(q_shape, k_shape=None, v_shape=None, block_q=128,
                     block_k=128):
     """Shape guard: self-attention only (q/k/v shapes equal), T divisible
-    into blocks, D lane-friendly."""
+    into blocks, D lane-friendly, and one head's K+V must fit VMEM (the
+    kernel keeps a (T, D) K and V slice resident while Q is tiled)."""
     if len(q_shape) != 4:
         return False
     for other in (k_shape, v_shape):
         if other is not None and tuple(other) != tuple(q_shape):
             return False  # cross-attention -> XLA path
     t, d = q_shape[2], q_shape[3]
+    # 2 * t * d * 4B (f32 upper bound) must leave VMEM room for q/o/acc
+    if 2 * t * d * 4 > 8 * 1024 * 1024:
+        return False
     return t % block_q == 0 and t % block_k == 0 and t >= block_q and \
         d % 8 == 0 and d <= 256
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
-                block_k, seq_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k, seq_len):
     # refs carry one (bh) slice: q (1, block_q, D), k/v (1, T, D)
     j = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # (bq, D)
@@ -68,12 +72,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
     m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
     l = jnp.zeros((bq, 1), jnp.float32)
     if causal:
-        # blocks strictly above the diagonal contribute nothing; stop early
-        num_kb = (j + 1) * block_q // block_k
+        # blocks at or below the diagonal only; ceil so partial blocks count
+        num_kb = ((j + 1) * block_q + block_k - 1) // block_k
     else:
         num_kb = seq_len // block_k
     acc, m, l = jax.lax.fori_loop(0, num_kb, fold, (acc, m, l))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    # log-sum-exp residual for the blocked backward
+    lse_ref[0] = m + jnp.log(l)
 
 
 try:  # pallas import kept lazy-safe for exotic builds
@@ -88,7 +95,7 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     """Blocked attention over (B, H, T, D); same semantics as
     ``attention_reference``."""
     return _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
-                           interpret)
+                           interpret)[0]
 
 
 def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -99,7 +106,7 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
     vf = v.reshape(b * h, t, d)
     kernel = functools.partial(_fwd_kernel, scale=sc, causal=causal,
                                block_q=block_q, block_k=block_k, seq_len=t)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q),
         in_specs=[
@@ -107,17 +114,23 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, t, d)
+    return out.reshape(b, h, t, d), lse.reshape(b, h, t, 1)
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
-                          interpret)
-    return out, (q, k, v, out)
+    out, lse = _flash_fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                               interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
@@ -125,33 +138,13 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     time against the saved log-sum-exp, so the (T, T) matrix never
     materialises in the backward either — O(T·block) live memory, matmuls
     on the MXU."""
-    q, k, v, out = res
+    q, k, v, out, lse = res
     b, h, t, d = q.shape
     sc = scale if scale is not None else 1.0 / math.sqrt(d)
     qf = q.astype(jnp.float32)
     gf = g.astype(jnp.float32)
     q_pos = jnp.arange(t)[:, None]
-
-    # pass 1 (blocked): per-row log-sum-exp of the scaled scores
-    def lse_fold(kb, carry):
-        m, l = carry
-        kb_ = jax.lax.dynamic_slice_in_dim(k, kb * block_k, block_k, 2)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf,
-                       kb_.astype(jnp.float32)) * sc
-        if causal:
-            k_pos = kb * block_k + jnp.arange(block_k)[None, :]
-            s = jnp.where((k_pos <= q_pos)[None, None], s, _NEG_INF)
-        bm = s.max(axis=-1, keepdims=True)
-        nm = jnp.maximum(m, bm)
-        l = l * jnp.exp(m - nm) + jnp.exp(s - nm).sum(axis=-1,
-                                                      keepdims=True)
-        return nm, l
-
     nkb = t // block_k
-    m0 = jnp.full((b, h, t, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, t, 1), jnp.float32)
-    m, l = jax.lax.fori_loop(0, nkb, lse_fold, (m0, l0))
-    lse = m + jnp.log(jnp.maximum(l, 1e-30))
     dsum = (gf * out.astype(jnp.float32)).sum(axis=-1, keepdims=True)
 
     # pass 2 (blocked): gradients per K-block

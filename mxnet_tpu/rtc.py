@@ -18,7 +18,6 @@ Example::
 from __future__ import annotations
 
 from .base import MXNetError
-from . import ndarray as nd
 
 __all__ = ["Rtc"]
 

@@ -456,21 +456,45 @@ def _sym_scalar(scalar_op, data, scalar):
 
 # -------------------------------------------------------------------- loading
 def load_json(json_str):
-    """Load a symbol from its JSON string (parity: mx.sym.load_json)."""
+    """Load a symbol from its JSON string (parity: mx.sym.load_json).
+
+    Accepts both this framework's JSON and the reference's formats,
+    including pre-nnvm legacy graphs (2-element input entries, ``param``/
+    ``attrs`` key variants — the upgrade path of reference
+    src/nnvm/legacy_json_util.cc)."""
     data = json.loads(json_str)
+
+    def entry(e):
+        # [node_id, out_index] (legacy) or [node_id, out_index, version]
+        return e[0], e[1]
+
     nodes = []
     for jn in data["nodes"]:
+        attr = jn.get("attr", jn.get("attrs", {})) or {}
         if jn["op"] == "null":
-            node = _Node(None, jn["name"], attr=jn.get("attr", {}))
+            node = _Node(None, jn["name"], attr=attr)
         else:
             op = _reg.get_op(jn["op"])
-            params = op.normalize_attrs(jn.get("param", {}))
-            node = _Node(op, jn["name"], params=params,
-                         attr=jn.get("attr", {}))
-            node.inputs = [(nodes[i], oi) for i, oi, _ in jn["inputs"]]
+            raw = jn.get("param", None)
+            if raw is None:
+                # nnvm-era JSON stores op params inside attrs
+                raw = {k: v for k, v in attr.items()
+                       if not k.startswith("__")}
+            params = op.normalize_attrs(raw)
+            node = _Node(op, jn["name"], params=params, attr=attr)
+            node.inputs = [(nodes[i], oi)
+                           for i, oi in map(entry, jn["inputs"])]
             node._arg_names = op.arg_names_for(params)
+            # pre-nnvm JSON omits implicit auxiliary-state inputs
+            # (BatchNorm moving stats): create the variables the modern
+            # graph carries explicitly
+            missing = len(node._arg_names) - len(node.inputs)
+            if missing > 0 and op.num_aux:
+                for an in node._arg_names[-missing:]:
+                    var = _Node(None, "%s_%s" % (jn["name"], an))
+                    node.inputs.append((var, 0))
         nodes.append(node)
-    return Symbol([(nodes[i], oi) for i, oi, _ in data["heads"]])
+    return Symbol([(nodes[i], oi) for i, oi in map(entry, data["heads"])])
 
 
 def load(fname):

@@ -148,3 +148,27 @@ def test_infer_type():
     args_t, outs_t, _ = s.infer_type(a=np.float32)
     assert args_t == [np.dtype(np.float32)]
     assert outs_t[0] == np.dtype(np.float16)
+
+
+def test_load_reference_legacy_json():
+    """The reference repo's own pre-nnvm JSON fixture loads, infers, and
+    runs (parity: legacy_json_util.cc upgrade path; fixture
+    tests/python/unittest/save_000800.json)."""
+    import os
+    path = "/root/reference/tests/python/unittest/save_000800.json"
+    if not os.path.exists(path):
+        import pytest
+        pytest.skip("reference fixture not mounted")
+    net = mx.sym.load(path)
+    assert net.list_outputs() == ["softmax_output"]
+    args = net.list_arguments()
+    assert "fc1_weight" in args and "batchnorm0_gamma" in args
+    _, out_shapes, _ = net.infer_shape(data=(4, 354))
+    assert out_shapes == [(4, 10)]
+    # attrs survived the upgrade (ctx_group/lr_mult on data)
+    ad = net.attr_dict()
+    assert ad["data"]["ctx_group"] == "stage1"
+    # and it binds + runs forward
+    ex = net.simple_bind(mx.cpu(), data=(4, 354), softmax_label=(4,))
+    out = ex.forward()[0]
+    assert out.shape == (4, 10)
