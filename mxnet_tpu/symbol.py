@@ -477,9 +477,13 @@ def load_json(json_str):
             op = _reg.get_op(jn["op"])
             raw = jn.get("param", None)
             if raw is None:
-                # nnvm-era JSON stores op params inside attrs
-                raw = {k: v for k, v in attr.items()
-                       if not k.startswith("__")}
+                # nnvm-era JSON stores op params inside attrs, mixed with
+                # user attributes — keep only keys the op declares, so
+                # ctx_group/lr_mult etc. don't leak into op kwargs
+                declared = set(op.attr_types) | set(op.defaults)
+                if op.key_var_num_args:
+                    declared.add(op.key_var_num_args)
+                raw = {k: v for k, v in attr.items() if k in declared}
             params = op.normalize_attrs(raw)
             node = _Node(op, jn["name"], params=params, attr=attr)
             node.inputs = [(nodes[i], oi)

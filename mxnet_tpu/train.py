@@ -38,6 +38,17 @@ def _pspec(*names):
     return PartitionSpec(*names)
 
 
+def _seq_replicated_sharding():
+    """Replicated NamedSharding on the active sequence mesh, or None when
+    sequence parallelism is off (the attention op shards inside)."""
+    from .parallel import mesh as mesh_mod
+    seq_mesh, _ = mesh_mod.sequence_mesh()
+    if seq_mesh is None:
+        return None
+    from jax.sharding import NamedSharding
+    return NamedSharding(seq_mesh, _pspec())
+
+
 class _FunctionalOptimizer(object):
     """Pure-function view of an Optimizer instance: (w, g, state, hyper) ->
     (new_w, new_state).  Hyper-params that change across steps (lr, Adam bias
@@ -288,6 +299,17 @@ class TrainStep(object):
             aux[n] = v
         opt_state = self.fopt.init_state(params)
         if self.mesh is None:
+            rep = _seq_replicated_sharding()
+            if rep is not None:
+                # sequence parallelism without an explicit dp/tp mesh: the
+                # step contains a shard_map over the sequence mesh, so all
+                # buffers must live replicated on it (attention shards them)
+                params = {n: jax.device_put(v, rep)
+                          for n, v in params.items()}
+                opt_state = {n: tuple(jax.device_put(s, rep) for s in st)
+                             for n, st in opt_state.items()}
+                aux = {n: jax.device_put(v, rep) for n, v in aux.items()}
+                return params, opt_state, aux
             # commit everything to the compute device in one hop so the fused
             # step runs there (host-committed params would drag the whole
             # computation onto the CPU backend); an explicitly-entered
@@ -320,9 +342,12 @@ class TrainStep(object):
     def shard_batch(self, batch):
         """Place a host batch dict on the mesh, sharded along 'dp' (axis 0)."""
         import jax
-        if self.mesh is None:
-            return {k: jax.numpy.asarray(v) for k, v in batch.items()}
         from jax.sharding import NamedSharding
+        if self.mesh is None:
+            rep = _seq_replicated_sharding()
+            if rep is not None:
+                return {k: jax.device_put(v, rep) for k, v in batch.items()}
+            return {k: jax.numpy.asarray(v) for k, v in batch.items()}
         sh = NamedSharding(self.mesh, _pspec("dp"))
         return {k: jax.device_put(v, sh) for k, v in batch.items()}
 

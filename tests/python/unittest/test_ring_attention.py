@@ -141,3 +141,38 @@ def test_transformer_sequence_parallel_matches():
     finally:
         mesh_mod.set_sequence_mesh(None)
     np.testing.assert_allclose(out_ring, out_plain, rtol=2e-4, atol=2e-5)
+
+
+@needs_8dev
+def test_sequence_parallel_training_matches():
+    """TrainStep under an sp mesh (ring attention through vjp + optimizer)
+    matches single-device training parameter-for-parameter."""
+    from mxnet_tpu.models import transformer
+    from mxnet_tpu.train import TrainStep
+    vocab, T, B = 16, 32, 2
+    net = transformer.get_symbol(vocab_size=vocab, seq_len=T, num_layers=1,
+                                 num_hidden=16, num_heads=2)
+    rng = RS(0)
+    x = rng.randint(0, vocab, (B, T)).astype(np.float32)
+    y = rng.randint(0, vocab, (B, T)).astype(np.float32)
+
+    def train(steps=3):
+        opt = mx.optimizer.SGD(learning_rate=0.1)
+        ts = TrainStep(net, opt)
+        params, state, aux = ts.init({"data": (B, T)},
+                                     {"softmax_label": (B, T)}, seed=4)
+        bd = ts.shard_batch({"data": x, "softmax_label": y})
+        for _ in range(steps):
+            params, state, aux, _ = ts(params, state, aux, bd)
+        return {k: np.asarray(v) for k, v in params.items()}
+
+    p_single = train()
+    m = mesh_mod.make_mesh({"sp": 8})
+    mesh_mod.set_sequence_mesh(m)
+    try:
+        p_ring = train()
+    finally:
+        mesh_mod.set_sequence_mesh(None)
+    for k in p_single:
+        np.testing.assert_allclose(p_ring[k], p_single[k], rtol=2e-4,
+                                   atol=2e-5)
