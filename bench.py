@@ -17,7 +17,7 @@ import time
 import numpy as np
 
 
-def bench_resnet50_train(batch=32, image=224, chunk=20, rounds=4,
+def bench_resnet50_train(batch=32, image=224, chunk=40, rounds=3,
                          dtype="bfloat16"):
     import jax
     import mxnet_tpu as mx
