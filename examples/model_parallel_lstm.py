@@ -113,7 +113,7 @@ def main():
                 continue
             updater(i, ex.grad_dict[name], ex.arg_dict[name])
         metric.update([mx.nd.array(y.reshape(-1))], [ex.outputs[0]])
-        if (step + 1) % 10 == 0:
+        if (step + 1) % 10 == 0 or step + 1 == args.num_batches:
             logging.info("batch %d perplexity %.2f", step + 1,
                          metric.get()[1])
             metric.reset()
