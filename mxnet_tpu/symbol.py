@@ -285,6 +285,13 @@ class Symbol(object):
         with open(fname, "w") as f:
             f.write(self.tojson())
 
+    def __reduce__(self):
+        # pickle via the JSON serde: graph nodes reference registered op
+        # objects (closures), which must be re-resolved from the registry
+        # on load — also what lets kvstore.set_optimizer ship an optimizer
+        # holding a sym to server processes (reference kvstore.py:232)
+        return (load_json, (self.tojson(),))
+
     def debug_str(self):
         lines = []
         for n in _topo([n for n, _ in self._outputs]):

@@ -16,19 +16,30 @@ ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
                                     "..", "..", ".."))
 
 
-@pytest.mark.timeout(300)
-def test_dist_sync_kvstore_two_processes():
+
+
+def _run_dist_script(script_name, n=2):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    # children build their own 2-process world; drop any outer test-mesh flags
+    # children build their own world; drop any outer test-mesh flags
     env.pop("XLA_FLAGS", None)
     proc = subprocess.run(
-        [sys.executable, os.path.join(ROOT, "tools", "launch.py"), "-n", "2",
-         sys.executable,
-         os.path.join(ROOT, "tests", "python", "dist",
-                      "dist_sync_kvstore.py")],
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", str(n), sys.executable,
+         os.path.join(ROOT, "tests", "python", "dist", script_name)],
         env=env, cwd=ROOT, capture_output=True, text=True, timeout=280)
     ok = proc.stdout.count("OK")
-    assert proc.returncode == 0 and ok == 2, (
+    assert proc.returncode == 0 and ok == n, (
         "rc=%d\nstdout:\n%s\nstderr:\n%s"
         % (proc.returncode, proc.stdout[-2000:], proc.stderr[-4000:]))
+
+@pytest.mark.timeout(300)
+def test_dist_sync_kvstore_two_processes():
+    _run_dist_script("dist_sync_kvstore.py")
+
+
+@pytest.mark.timeout(300)
+def test_dist_data_parallel_training():
+    """2-process data-parallel training converges and replicas stay in
+    lockstep (parity: tests/nightly/dist_lenet.py, shrunk)."""
+    _run_dist_script("dist_mlp.py")
