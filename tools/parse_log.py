@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Parse training-log output into a markdown table (parity: reference
+tools/parse_log.py — same Epoch[N] Train/Validation/Time line grammar that
+Module.fit + Speedometer emit)."""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+def parse(lines):
+    pats = [
+        ("train", re.compile(r".*Epoch\[(\d+)\] Train.*=([.\d]+)")),
+        ("valid", re.compile(r".*Epoch\[(\d+)\] Valid.*=([.\d]+)")),
+        ("time", re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")),
+    ]
+    data = {}
+    for line in lines:
+        for name, pat in pats:
+            m = pat.match(line)
+            if m:
+                epoch = int(m.group(1))
+                val = float(m.group(2))
+                data.setdefault(epoch, {})[name] = val
+                break
+    return data
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile", nargs=1, type=str)
+    ap.add_argument("--format", type=str, default="markdown",
+                    choices=["markdown", "none"])
+    args = ap.parse_args()
+    with open(args.logfile[0]) as f:
+        data = parse(f.readlines())
+    if args.format == "markdown":
+        print("| epoch | train-accuracy | valid-accuracy | time |")
+        print("| --- | --- | --- | --- |")
+        for e in sorted(data):
+            d = data[e]
+            print("| %d | %s | %s | %s |" % (
+                e, d.get("train", ""), d.get("valid", ""),
+                d.get("time", "")))
+    else:
+        for e in sorted(data):
+            d = data[e]
+            print(e, d.get("train", ""), d.get("valid", ""),
+                  d.get("time", ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
