@@ -25,3 +25,30 @@ from . import attention      # noqa: F401  (NEW: dot_product_attention/ring,
                              #  LayerNorm — no reference analogue, §5.7)
 from . import misc           # noqa: F401  (ndarray-fun registry tail,
                              #  KL sparse reg, v1 aliases)
+
+# ---------------------------------------------------------------- layout pass
+# Shape-agnostic ops the executor's NHWC layout pass may flow channel-last
+# activations through unchanged (see executor._Lowered.run).  Ops that bake
+# in a channel axis (FullyConnected, Flatten, Reshape, SoftmaxOutput, the
+# spatial family, ...) stay rigid: the pass restores logical NCHW for them.
+_LAYOUT_TRANSPARENT = [
+    # unary elementwise
+    "relu", "sigmoid", "tanh", "exp", "log", "negative", "abs", "sign",
+    "square", "sqrt", "rsqrt", "_copy", "BlockGrad", "Cast", "Dropout",
+    "Activation", "clip",
+    # binary elementwise (same-shape; residual adds)
+    "_Plus", "_Minus", "_Mul", "_Div", "_Maximum", "_Minimum",
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "add_n",
+    # scalar variants
+    "_PlusScalar", "_MinusScalar", "_RMinusScalar", "_MulScalar",
+    "_DivScalar", "_RDivScalar", "_MaximumScalar", "_MinimumScalar",
+]
+for _name in _LAYOUT_TRANSPARENT:
+    try:
+        get_op(_name).layout_rule = "transparent"
+    except Exception:
+        pass
+# LeakyReLU: transparent except prelu (whose gamma broadcasts over axis 1)
+get_op("LeakyReLU").layout_rule = (
+    lambda attrs: None if attrs.get("act_type") == "prelu" else "transparent")

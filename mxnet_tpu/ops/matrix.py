@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as _np
 
 from ..base import MXNetError
-from .registry import register, parse_bool, parse_int, parse_tuple
+from .registry import register, parse_bool, parse_int, parse_str, parse_tuple
 
 
 def infer_reshape(shape, target):
@@ -190,10 +190,16 @@ def _concat_infer(attrs, in_shapes):
           arg_names=lambda attrs: ["arg%d" % i
                                    for i in range(int(attrs.get("num_args", 1)))],
           key_var_num_args="num_args",
-          attr_types={"num_args": parse_int, "dim": parse_int},
-          defaults={"dim": 1}, infer_shape=_concat_infer)
-def _concat(*args, num_args=None, dim=1):
-    """(parity: src/operator/concat.cc)"""
+          attr_types={"num_args": parse_int, "dim": parse_int,
+                      "layout": parse_str},
+          defaults={"dim": 1}, infer_shape=_concat_infer,
+          layout_rule=lambda attrs: (
+              "aware_all" if int(attrs.get("dim", 1)) == 1 else None))
+def _concat(*args, num_args=None, dim=1, layout=None):
+    """(parity: src/operator/concat.cc); under the NHWC layout pass a
+    channel concat (dim=1) runs on channel-last inputs as axis -1."""
+    if layout == "NHWC":
+        dim = -1
     return jnp.concatenate(args, axis=dim)
 
 

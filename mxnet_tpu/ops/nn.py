@@ -11,6 +11,8 @@ TPU-first notes:
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as _np
@@ -169,14 +171,18 @@ _CONV_ATTRS = {"kernel": parse_tuple, "stride": parse_tuple, "dilate": parse_tup
           attr_types=_CONV_ATTRS,
           defaults={"stride": (), "dilate": (), "pad": (), "num_group": 1,
                     "no_bias": False},
-          infer_shape=_conv_infer)
+          infer_shape=_conv_infer, layout_rule="aware")
 def _convolution(data, weight, bias=None, kernel=None, stride=(), dilate=(),
                  pad=(), num_filter=None, num_group=1, workspace=None,
                  no_bias=False, cudnn_tune=None, cudnn_off=False, layout=None):
     """N-D convolution (parity: convolution-inl.h / cudnn_convolution-inl.h).
 
     Lowered to one XLA conv HLO; `workspace`/`cudnn_*` accepted for API parity
-    and ignored (XLA owns algorithm choice on TPU)."""
+    and ignored (XLA owns algorithm choice on TPU).  With layout='NHWC'
+    (injected by the executor's layout pass) ``data`` arrives channel-last —
+    the layout the TPU prefers end-to-end; the weight keeps its logical
+    (O, I, *k) shape and is transposed here (cheap: weights are small next to
+    activations, and XLA folds the transpose into its weight prefetch)."""
     nd = len(kernel)
     stride = _tup(stride, nd, 1)
     dilate = _tup(dilate, nd, 1)
@@ -184,13 +190,19 @@ def _convolution(data, weight, bias=None, kernel=None, stride=(), dilate=(),
     spatial = "DHW"[-nd:] if nd <= 3 else None
     if spatial is None:
         raise MXNetError("Convolution supports 1-3 spatial dims")
-    dn = ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+    if layout == "NHWC":
+        dn = ("N" + spatial + "C", spatial + "IO", "N" + spatial + "C")
+        weight = jnp.transpose(weight, tuple(range(2, 2 + nd)) + (1, 0))
+    else:
+        dn = ("NC" + spatial, "OI" + spatial, "NC" + spatial)
     out = jax.lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
         dimension_numbers=dn, feature_group_count=num_group)
     if bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        cshape = ((1,) + (1,) * nd + (-1,)) if layout == "NHWC" \
+            else ((1, -1) + (1,) * nd)
+        out = out + bias.reshape(cshape)
     return out
 
 
@@ -280,16 +292,20 @@ def _pool_infer(attrs, in_shapes):
 @register("Pooling", aliases=("Pooling_v1",),
           attr_types={"kernel": parse_tuple, "stride": parse_tuple,
                       "pad": parse_tuple, "pool_type": parse_str,
-                      "global_pool": parse_bool, "pooling_convention": parse_str},
+                      "global_pool": parse_bool, "pooling_convention": parse_str,
+                      "layout": parse_str},
           defaults={"stride": (), "pad": (), "pool_type": "max",
                     "global_pool": False, "pooling_convention": "valid"},
-          infer_shape=_pool_infer)
+          infer_shape=_pool_infer, layout_rule="aware")
 def _pooling(data, kernel=None, stride=(), pad=(), pool_type="max",
-             global_pool=False, pooling_convention="valid"):
+             global_pool=False, pooling_convention="valid", layout=None):
     """N-D pooling via XLA reduce_window (parity: pooling-inl.h / pool.h)."""
     nd = data.ndim - 2
+    sp_axes = tuple(range(1, 1 + nd)) if layout == "NHWC" \
+        else tuple(range(2, 2 + nd))
+    sp_shape = tuple(data.shape[a] for a in sp_axes)
     if global_pool:
-        kernel = data.shape[2:]
+        kernel = sp_shape
         stride = (1,) * nd
         pad = (0,) * nd
     else:
@@ -298,14 +314,19 @@ def _pooling(data, kernel=None, stride=(), pad=(), pool_type="max",
         pad = _tup(pad, nd, 0)
     # padding, possibly asymmetric for 'full' convention
     pads = []
-    for i, k, s, p in zip(data.shape[2:], kernel, stride, pad):
+    for i, k, s, p in zip(sp_shape, kernel, stride, pad):
         out = _pool_out_dim(i, k, s, p, pooling_convention if not global_pool
                             else "valid")
         needed = (out - 1) * s + k - i - p
         pads.append((p, max(needed, p)))
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    padding = [(0, 0), (0, 0)] + pads
+    if layout == "NHWC":
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        padding = [(0, 0)] + pads + [(0, 0)]
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        padding = [(0, 0), (0, 0)] + pads
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
             jnp.iinfo(data.dtype).min
@@ -321,20 +342,141 @@ def _pooling(data, kernel=None, stride=(), pad=(), pool_type="max",
         # pool.h:268 — pool_size = (hend-hstart)*(wend-wstart) pre-clip).
         # Static shapes → compute per-axis divisors at trace time.
         cnt = None
-        out_spatial = ssum.shape[2:]
+        out_spatial = tuple(ssum.shape[a] for a in sp_axes)
+        lead = 1 if layout == "NHWC" else 2
+        trail = 1 if layout == "NHWC" else 0
         for ax, (i_sz, k, s, p, o_sz) in enumerate(
-                zip(data.shape[2:], kernel, stride, pad, out_spatial)):
+                zip(sp_shape, kernel, stride, pad, out_spatial)):
             starts = _np.arange(o_sz) * s - p
             ends = _np.minimum(starts + k, i_sz + p)
             d = jnp.asarray((ends - starts).astype(_np.float32))
-            d = d.reshape((1, 1) + (1,) * ax + (o_sz,)
-                          + (1,) * (len(out_spatial) - ax - 1))
+            d = d.reshape((1,) * lead + (1,) * ax + (o_sz,)
+                          + (1,) * (len(out_spatial) - ax - 1)
+                          + (1,) * trail)
             cnt = d if cnt is None else cnt * d
         return (ssum / cnt).astype(data.dtype)
     raise MXNetError("unknown pool_type %s" % pool_type)
 
 
 # ------------------------------------------------------------------- BatchNorm
+def _bn_axes(ndim, caxis):
+    caxis = caxis % ndim
+    axes = tuple(a for a in range(ndim) if a != caxis)
+    cshape = tuple(-1 if a == caxis else 1 for a in range(ndim))
+    return axes, cshape
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train_core(x, g, b, eps, caxis=1):
+    """Training-mode batch norm with a hand-written backward.
+
+    Autodiff through f32 batch statistics materialises f32 activation-sized
+    tensors in the backward pass — 2x the HBM traffic of bf16 on what is
+    already the bandwidth-bound part of a conv net.  The custom VJP keeps
+    every activation-sized tensor in x.dtype (only the per-channel reductions
+    accumulate in f32), which is both faster and *more* accurate than bf16
+    statistics.  Returns (out, mean, var) with mean/var in f32."""
+    out, mean, var, _inv = _bn_train_fwd_impl(x, g, b, eps, caxis)
+    return out, mean, var
+
+
+def _bn_train_fwd_impl(x, g, b, eps, caxis):
+    axes, cshape = _bn_axes(x.ndim, caxis)
+    # stats accumulate in at-least-f32 (f64 inputs keep f64 — numeric-gradient
+    # tests rely on it); the convert fuses into the reduces, never materialised
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    x32 = x.astype(acc)
+    mean = jnp.mean(x32, axis=axes)
+    var = jnp.mean(jnp.square(x32), axis=axes) - jnp.square(mean)
+    var = jnp.maximum(var, 0.0)
+    inv = jax.lax.rsqrt(var + eps)
+    scale = g.astype(acc) * inv
+    shift = b.astype(acc) - mean * scale
+    out = x * scale.reshape(cshape).astype(x.dtype) \
+        + shift.reshape(cshape).astype(x.dtype)
+    return out, mean, var, inv
+
+
+def _bn_train_core_fwd(x, g, b, eps, caxis):
+    out, mean, var, inv = _bn_train_fwd_impl(x, g, b, eps, caxis)
+    return (out, mean, var), (x, g, mean, inv)
+
+
+def _bn_train_core_bwd(eps, caxis, res, cts):
+    dy, dmean_ct, dvar_ct = cts
+    x, g, mean, inv = res
+    return _bn_bwd_shared(caxis, x, g, mean, inv, dy, dmean_ct, dvar_ct)
+
+
+def _bn_bwd_shared(caxis, x, g, mean, inv, dy, dmean_ct, dvar_ct):
+    axes, cshape = _bn_axes(x.ndim, caxis)
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    n = jnp.asarray(n, acc)
+    g32 = g.astype(acc)
+    # per-channel f32 reductions over x.dtype elementwise products (the
+    # bf16 multiply fuses into the reduce; accumulation is f32)
+    sum_dy = jnp.sum(dy.astype(acc), axis=axes)
+    sum_dy_x = jnp.sum((dy * x).astype(acc), axis=axes)
+    sum_dy_xhat = inv * (sum_dy_x - mean * sum_dy)
+    dgamma = sum_dy_xhat
+    dbeta = sum_dy
+    # cotangent contributions from the (rarely used) mean/var outputs fold
+    # into the same per-channel affine form dx = A*dy + B*x + C
+    # dL/dv = -1/2 inv^2 g sum(dy*xhat)  (inv^2, not inv^3: the reduction is
+    # over dy*xhat, which already carries one factor of inv)
+    dvar = -0.5 * inv ** 2 * g32 * sum_dy_xhat + dvar_ct.astype(acc)
+    dmean = -inv * g32 * sum_dy + dmean_ct.astype(acc)
+    coef_dy = g32 * inv
+    coef_x = 2.0 * dvar / n
+    coef_1 = dmean / n - coef_x * mean
+    dx = dy * coef_dy.reshape(cshape).astype(x.dtype) \
+        + x * coef_x.reshape(cshape).astype(x.dtype) \
+        + coef_1.reshape(cshape).astype(x.dtype)
+    return dx, dgamma.astype(g.dtype), dbeta.astype(g.dtype)
+
+
+_bn_train_core.defvjp(_bn_train_core_fwd, _bn_train_core_bwd)
+
+
+# ------------------------------------------------------- fused BatchNorm+ReLU
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_relu_train_core(x, g, b, eps, caxis=1):
+    """BatchNorm(train) + ReLU in one op with a hand-written backward.
+
+    The executor fuses BatchNorm->Activation(relu) pairs (the universal conv
+    net idiom) onto this op so the backward recomputes the relu mask from the
+    saved pre-BN tensor instead of keeping the BN output alive — one fewer
+    activation-sized residual read per layer on the HBM-bandwidth-bound path."""
+    out, mean, var, _inv = _bn_train_fwd_impl(x, g, b, eps, caxis)
+    return jnp.maximum(out, 0), mean, var
+
+
+def _bn_relu_train_core_fwd(x, g, b, eps, caxis):
+    out, mean, var, inv = _bn_train_fwd_impl(x, g, b, eps, caxis)
+    return (jnp.maximum(out, 0), mean, var), (x, g, b, mean, inv)
+
+
+def _bn_relu_train_core_bwd(eps, caxis, res, cts):
+    dy, dmean_ct, dvar_ct = cts
+    x, g, b, mean, inv = res
+    _, cshape = _bn_axes(x.ndim, caxis)
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    scale = g.astype(acc) * inv
+    shift = b.astype(acc) - mean * scale
+    # recompute the pre-activation sign from x (fused elementwise — cheaper
+    # than saving the BN output): relu gate on the incoming cotangent
+    pre = x * scale.reshape(cshape).astype(x.dtype) \
+        + shift.reshape(cshape).astype(x.dtype)
+    dy = jnp.where(pre > 0, dy, jnp.zeros((), dy.dtype))
+    return _bn_bwd_shared(caxis, x, g, mean, inv, dy, dmean_ct, dvar_ct)
+
+
+_bn_relu_train_core.defvjp(_bn_relu_train_core_fwd, _bn_relu_train_core_bwd)
+
+
 def _bn_infer(attrs, in_shapes):
     data = in_shapes[0]
     c = None if data is None else (data[1],)
@@ -350,36 +492,74 @@ def _bn_infer(attrs, in_shapes):
           num_outputs=lambda attrs: 3 if attrs.get("output_mean_var", False) else 1,
           attr_types={"eps": parse_float, "momentum": parse_float,
                       "fix_gamma": parse_bool, "use_global_stats": parse_bool,
-                      "output_mean_var": parse_bool},
+                      "output_mean_var": parse_bool, "layout": parse_str},
           defaults={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,
                     "use_global_stats": False, "output_mean_var": False},
-          infer_shape=_bn_infer, train_aware=True)
+          infer_shape=_bn_infer, train_aware=True, layout_rule="aware")
 def _batch_norm(data, gamma, beta, moving_mean, moving_var, is_train=False,
                 eps=1e-3, momentum=0.9, fix_gamma=True, use_global_stats=False,
-                output_mean_var=False):
+                output_mean_var=False, layout=None):
     """Batch normalization (parity: batch_norm-inl.h / cudnn_batch_norm).
 
     Returns (out[, mean, var], new_moving_mean, new_moving_var); the trailing two
     are auxiliary-state updates collected by the executor."""
-    axes = (0,) + tuple(range(2, data.ndim))
-    cshape = (1, -1) + (1,) * (data.ndim - 2)
+    caxis = -1 if layout == "NHWC" else 1
+    _, cshape = _bn_axes(data.ndim, caxis)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
+    # statistics and the affine math are f32 even for bf16 data (bf16
+    # mean/var over large N*H*W loses precision); every activation-sized
+    # tensor stays in data.dtype — forward via fused convert-into-reduce,
+    # backward via the hand-written VJP of _bn_train_core
     if is_train and not use_global_stats:
-        mean = jnp.mean(data, axis=axes)
-        var = jnp.var(data, axis=axes)
-        new_mm = moving_mean * momentum + mean * (1 - momentum)
-        new_mv = moving_var * momentum + var * (1 - momentum)
+        out, mean, var = _bn_train_core(data, g, beta, float(eps), caxis)
+        mom = jnp.float32(momentum)
+        new_mm = moving_mean * mom + mean.astype(moving_mean.dtype) * (1 - mom)
+        new_mv = moving_var * mom + var.astype(moving_var.dtype) * (1 - mom)
     else:
-        mean, var = moving_mean, moving_var
-        mean = jax.lax.stop_gradient(mean)
-        var = jax.lax.stop_gradient(var)
+        acc = jnp.promote_types(data.dtype, jnp.float32)
+        mean = jax.lax.stop_gradient(moving_mean).astype(acc)
+        var = jax.lax.stop_gradient(moving_var).astype(acc)
         new_mm, new_mv = moving_mean, moving_var
-    inv = jax.lax.rsqrt(var.reshape(cshape) + eps)
-    out = (data - mean.reshape(cshape)) * inv * g.reshape(cshape) \
-        + beta.reshape(cshape)
+        inv = jax.lax.rsqrt(var + eps)
+        scale = g.astype(acc) * inv
+        shift = beta.astype(acc) - mean * scale
+        out = data * scale.reshape(cshape).astype(data.dtype) \
+            + shift.reshape(cshape).astype(data.dtype)
     if output_mean_var:
         return out, mean, var, new_mm, new_mv
     return out, new_mm, new_mv
+
+
+@register("_BatchNormReLU", arg_names=("data", "gamma", "beta", "moving_mean",
+                                       "moving_var"),
+          aux_names=("moving_mean", "moving_var"), num_outputs=1,
+          attr_types={"eps": parse_float, "momentum": parse_float,
+                      "fix_gamma": parse_bool, "use_global_stats": parse_bool,
+                      "output_mean_var": parse_bool, "layout": parse_str},
+          defaults={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,
+                    "use_global_stats": False, "output_mean_var": False},
+          infer_shape=_bn_infer, train_aware=True, layout_rule="aware",
+          hidden=True)
+def _batch_norm_relu(data, gamma, beta, moving_mean, moving_var,
+                     is_train=False, eps=1e-3, momentum=0.9, fix_gamma=True,
+                     use_global_stats=False, output_mean_var=False,
+                     layout=None):
+    """Executor-fused BatchNorm+ReLU (no reference analogue; the reference
+    relies on cuDNN fusing these — here the fusion also rewrites the backward
+    to recompute the relu mask rather than save the BN output)."""
+    caxis = -1 if layout == "NHWC" else 1
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if is_train and not use_global_stats:
+        out, mean, var = _bn_relu_train_core(data, g, beta, float(eps), caxis)
+        mom = jnp.float32(momentum)
+        new_mm = moving_mean * mom + mean.astype(moving_mean.dtype) * (1 - mom)
+        new_mv = moving_var * mom + var.astype(moving_var.dtype) * (1 - mom)
+        return out, new_mm, new_mv
+    res = _batch_norm(data, gamma, beta, moving_mean, moving_var,
+                      is_train=is_train, eps=eps, momentum=momentum,
+                      fix_gamma=fix_gamma, use_global_stats=use_global_stats,
+                      layout=layout)
+    return (jnp.maximum(res[0], 0),) + tuple(res[1:])
 
 
 @register("InstanceNorm", arg_names=("data", "gamma", "beta"),

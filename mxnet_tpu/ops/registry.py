@@ -113,8 +113,17 @@ class OpDef(object):
                  attr_types=None, defaults=None, infer_shape=None, infer_type=None,
                  infer_shape_backward=None, input_init_attrs=None,
                  needs_rng=False, train_aware=False, key_var_num_args=None,
-                 aliases=(), hidden=False, doc=None, is_loss=False):
+                 aliases=(), hidden=False, doc=None, is_loss=False,
+                 layout_rule=None, layout_inputs=(0,)):
         self.name = name
+        # how the executor's NHWC layout pass treats this op (see
+        # executor._Lowered.run): None = rigid (inputs restored to logical
+        # NCHW), 'aware' = fn accepts layout='NHWC' and executes channel-last
+        # on the inputs listed in layout_inputs, 'aware_all' = same with every
+        # input channel-last (Concat), 'transparent' = shape-agnostic, layout
+        # flows through.  May be callable(attrs) -> one of those.
+        self.layout_rule = layout_rule
+        self.layout_inputs = tuple(layout_inputs)
         self.fn = fn
         self.is_loss = is_loss
         self._arg_names = arg_names
@@ -290,7 +299,8 @@ def jitted(op, attrs, is_train=False):
     # key it so toggling set_sequence_mesh never reuses a stale program
     from ..parallel import mesh as _mesh_mod
     seq_mesh, seq_axis = _mesh_mod.sequence_mesh()
-    seq_key = None if seq_mesh is None else (id(seq_mesh), seq_axis)
+    seq_key = None if seq_mesh is None else (
+        _mesh_mod.mesh_cache_key(seq_mesh), seq_axis)
     key = (op.name, attr_key(attrs), bool(is_train), seq_key)
     fn = _JIT_CACHE.get(key)
     if fn is None:

@@ -30,10 +30,16 @@ __all__ = ["health_check", "num_dead_node", "is_recovery",
 
 
 _health_lock = threading.Lock()
+_health_generation = [0]
 
 
 def health_check(timeout=30.0, name="health"):
     """True when every process reaches a barrier within ``timeout`` seconds.
+
+    COLLECTIVE call: every process in the world must invoke it the same
+    number of times (the generation suffix below is process-local, so an
+    asymmetric call pattern desyncs barrier names — exactly like calling the
+    reference's ps-lite Barrier from only one worker).
 
     Replaces ps-lite heartbeat polling: on TPU a missing peer does not
     heartbeat-timeout, it stalls the next collective — so health IS
@@ -41,22 +47,27 @@ def health_check(timeout=30.0, name="health"):
     dead world cannot hang the caller.
 
     Caveat: a *timed-out* check leaves its barrier pending on the daemon
-    thread.  If the world was merely slow (not dead), that stale barrier can
-    desync later collectives — so treat False as fatal and restart the world
-    (the tools/launch.py --max-restarts supervisor does exactly this);
-    don't keep training after a failed health check.  A module-level lock
+    thread.  If the world was merely slow (not dead), the stale barrier could
+    otherwise satisfy a *later* check's barrier on peers and desync the
+    world; each check therefore uses a process-local generation suffix so a
+    stale pending barrier can never pair with a newer one.  Still treat
+    False as fatal and restart the world (the tools/launch.py
+    --max-restarts supervisor does exactly this).  A module-level lock
     serialises checks within this process."""
     from . import dist
     ok = threading.Event()
 
-    def _barrier():
-        try:
-            dist.barrier(name)
-            ok.set()
-        except Exception:
-            pass
-
     with _health_lock:
+        _health_generation[0] += 1
+        barrier_name = "%s-%d" % (name, _health_generation[0])
+
+        def _barrier():
+            try:
+                dist.barrier(barrier_name)
+                ok.set()
+            except Exception:
+                pass
+
         t = threading.Thread(target=_barrier, daemon=True)
         t.start()
         t.join(timeout)
@@ -137,6 +148,11 @@ def fit_elastic(module, train_data, prefix, num_epoch, eval_data=None,
         # skipping to begin_epoch would silently lose the trained epochs
         fit_kwargs["arg_params"] = arg_params
         fit_kwargs["aux_params"] = aux_params
+        # force_init: fit() calls init_params(force_init=False), which
+        # early-returns when the module was already initialised in-process —
+        # the checkpoint weights would be silently ignored while begin_epoch
+        # still skips ahead.  On a resume the checkpoint must actually load.
+        fit_kwargs["force_init"] = True
         begin = epoch
         states = "%s-%04d.states" % (prefix, epoch)
         if save_optimizer_states and os.path.exists(states):

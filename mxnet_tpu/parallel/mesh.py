@@ -15,7 +15,18 @@ import numpy as _np
 from ..base import MXNetError
 
 __all__ = ["make_mesh", "data_parallel_mesh", "local_devices_for",
-           "set_sequence_mesh", "sequence_mesh"]
+           "set_sequence_mesh", "sequence_mesh", "mesh_cache_key"]
+
+
+def mesh_cache_key(mesh):
+    """Stable hashable identity for a Mesh, safe to key compiled-program
+    caches by.  ``id(mesh)`` is not: after the mesh is garbage-collected
+    CPython can reuse the id for a new mesh and the cache would silently
+    serve a program lowered for the old devices/axis sizes."""
+    if mesh is None:
+        return None
+    return (tuple(mesh.axis_names), tuple(mesh.shape.values()),
+            tuple(d.id for d in mesh.devices.flat))
 
 # process-global sequence-parallel mesh: when set, attention ops lower to
 # ring attention over this mesh (see ops/attention.py)

@@ -79,7 +79,12 @@ def ring_attention(q, k, v, mesh, axis="sp", causal=False, scale=None):
             # block i arrived from rank (idx - i) mod n
             src = (idx - i) % n
             k_pos = src * tl + jnp.arange(tl)
-            s = jnp.einsum("bhqd,bhkd->bhqk", qb, kb) * sc
+            # scores and the online-softmax state stay in f32 regardless of
+            # input dtype: bf16 exp-sums/correction factors accumulated over
+            # many ring steps degrade long-context accuracy (the Pallas flash
+            # kernel keeps these in f32 for the same reason)
+            s = jnp.einsum("bhqd,bhkd->bhqk", qb, kb,
+                           preferred_element_type=jnp.float32) * sc
             if causal:
                 mask = k_pos[None, :] <= q_pos[:, None]
                 s = jnp.where(mask[None, None], s, -jnp.inf)
@@ -93,7 +98,8 @@ def ring_attention(q, k, v, mesh, axis="sp", causal=False, scale=None):
             corr = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m) - safe_m)
             corr = jnp.where(jnp.isneginf(m), 0.0, corr)
             l = l * corr + p.sum(axis=-1, keepdims=True)
-            o = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+            o = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, vb,
+                                      preferred_element_type=jnp.float32)
             return o, new_m, l
 
         def body(i, carry):
@@ -104,14 +110,14 @@ def ring_attention(q, k, v, mesh, axis="sp", causal=False, scale=None):
             vb = jax.lax.ppermute(vb, axis, perm)
             return o, m, l, kb, vb
 
-        o = jnp.zeros_like(qb)
-        m = jnp.full(qb.shape[:3] + (1,), -jnp.inf, qb.dtype)
-        l = jnp.zeros(qb.shape[:3] + (1,), qb.dtype)
+        o = jnp.zeros(qb.shape, jnp.float32)
+        m = jnp.full(qb.shape[:3] + (1,), -jnp.inf, jnp.float32)
+        l = jnp.zeros(qb.shape[:3] + (1,), jnp.float32)
         # n-1 rotated folds, then the last block in place: no wasted final hop
         o, m, l, kb, vb = jax.lax.fori_loop(0, n - 1, body,
                                             (o, m, l, kb, vb))
         o, m, l = fold(n - 1, o, m, l, kb, vb)
-        return o / jnp.maximum(l, 1e-30)
+        return (o / jnp.maximum(l, 1e-30)).astype(qb.dtype)
 
     spec = P(None, None, axis, None)
     fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),

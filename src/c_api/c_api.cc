@@ -120,7 +120,12 @@ int StrListOut(PyObject *list, mx_uint *out_size, const char ***out_array) {
   scratch.strings.clear();
   scratch.cstrs.clear();
   for (Py_ssize_t i = 0; i < n; ++i) {
-    scratch.strings.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(list, i)));
+    const char *s = PyUnicode_AsUTF8(PyList_GetItem(list, i));
+    if (s == nullptr) {  // non-string or non-UTF8-encodable element
+      last_error = FetchPyError();
+      return -1;
+    }
+    scratch.strings.emplace_back(s);
   }
   for (auto &s : scratch.strings) scratch.cstrs.push_back(s.c_str());
   *out_size = static_cast<mx_uint>(n);
@@ -298,7 +303,10 @@ int MXNDArrayLoad(const char *fname, mx_uint *out_size,
   }
   *out_size = static_cast<mx_uint>(n);
   *out_arr = scratch.handles.data();
-  StrListOut(names, out_name_size, out_names);
+  if (StrListOut(names, out_name_size, out_names) != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
   Py_DECREF(r);
   API_END();
 }
@@ -316,7 +324,10 @@ int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
   API_BEGIN();
   PyObject *r = CallShim("list_all_op_names", nullptr);
   CHECK_PY(r);
-  StrListOut(r, out_size, out_array);
+  if (StrListOut(r, out_size, out_array) != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
   Py_DECREF(r);
   API_END();
 }
@@ -376,7 +387,10 @@ static int SymbolStrList(const char *fn, SymbolHandle symbol,
   PyObject *r = CallShim(fn, args);
   Py_DECREF(args);
   CHECK_PY(r);
-  StrListOut(r, out_size, out_array);
+  if (StrListOut(r, out_size, out_array) != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
   Py_DECREF(r);
   API_END();
 }
