@@ -28,6 +28,16 @@ typedef unsigned int mx_uint;
 typedef float mx_float;
 typedef void *NDArrayHandle;
 typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *KVStoreHandle;
+typedef void *DataIterHandle;
+typedef void *AtomicSymbolCreator;
+typedef void *DataIterCreator;
+
+/*! \brief user-defined gradient updater installed on a KVStore
+ *  (parity: reference include/mxnet/c_api.h MXKVStoreUpdater) */
+typedef void (*MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                 NDArrayHandle local, void *handle);
 
 /*! \brief return the last error message on this thread */
 MXNET_DLL const char *MXGetLastError();
@@ -56,6 +66,36 @@ MXNET_DLL int MXNDArrayLoad(const char *fname, mx_uint *out_size,
                             NDArrayHandle **out_arr, mx_uint *out_name_size,
                             const char ***out_names);
 MXNET_DLL int MXNDArrayWaitAll();
+/*! \brief create with explicit dtype (0=f32 1=f64 2=f16 3=u8 4=i32 5=i8 6=i64) */
+MXNET_DLL int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim,
+                                int dev_type, int dev_id, int delay_alloc,
+                                int dtype, NDArrayHandle *out);
+MXNET_DLL int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
+MXNET_DLL int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                                  int *out_dev_id);
+/*! \brief slice along axis 0, [begin, end) — shares storage semantics with
+ *  the source array (writes through, parity: NDArray::Slice) */
+MXNET_DLL int MXNDArraySlice(NDArrayHandle handle, mx_uint begin,
+                             mx_uint end, NDArrayHandle *out);
+MXNET_DLL int MXNDArrayAt(NDArrayHandle handle, mx_uint idx,
+                          NDArrayHandle *out);
+MXNET_DLL int MXNDArrayReshape(NDArrayHandle handle, int ndim,
+                               const int *dims, NDArrayHandle *out);
+/*! \brief typed raw copy: buffer dtype == array dtype, size in bytes */
+MXNET_DLL int MXNDArraySyncCopyFromCPUEx(NDArrayHandle handle,
+                                         const void *data, size_t nbytes);
+MXNET_DLL int MXNDArraySyncCopyToCPUEx(NDArrayHandle handle, void *data,
+                                       size_t nbytes);
+
+/* --------------------------------------------- imperative op invocation */
+/*! \brief eager single-op execution on NDArrays (parity: MXImperativeInvoke,
+ *  reference c_api.h:510).  If *num_outputs > 0, *outputs carries
+ *  preallocated arrays written in place; otherwise the call allocates. */
+MXNET_DLL int MXImperativeInvoke(AtomicSymbolCreator creator,
+                                 int num_inputs, NDArrayHandle *inputs,
+                                 int *num_outputs, NDArrayHandle **outputs,
+                                 int num_params, const char **param_keys,
+                                 const char **param_vals);
 
 /* ---------------------------------------------------------------- Symbol */
 MXNET_DLL int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
@@ -70,6 +110,147 @@ MXNET_DLL int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
 MXNET_DLL int MXSymbolListAuxiliaryStates(SymbolHandle symbol,
                                           mx_uint *out_size,
                                           const char ***out_str_array);
+/*! \brief enumerate operator creators (parity: reference c_api.h:545);
+ *  creator handles are shared with MXImperativeInvoke */
+MXNET_DLL int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                               AtomicSymbolCreator **out);
+MXNET_DLL int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                          const char **name);
+/*! \brief operator reflection (parity: MXSymbolGetAtomicSymbolInfo,
+ *  reference c_api.h:563) — feeds cpp-package op.h autogeneration */
+MXNET_DLL int MXSymbolGetAtomicSymbolInfo(
+    AtomicSymbolCreator creator, const char **name, const char **description,
+    mx_uint *num_args, const char ***arg_names, const char ***arg_type_infos,
+    const char ***arg_descriptions, const char **key_var_num_args);
+MXNET_DLL int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                                         mx_uint num_param,
+                                         const char **keys,
+                                         const char **vals,
+                                         SymbolHandle *out);
+MXNET_DLL int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+MXNET_DLL int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                                  SymbolHandle *out);
+/*! \brief compose an atomic symbol with its inputs, in place on the handle */
+MXNET_DLL int MXSymbolCompose(SymbolHandle sym, const char *name,
+                              mx_uint num_args, const char **keys,
+                              SymbolHandle *args);
+MXNET_DLL int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out);
+MXNET_DLL int MXSymbolPrint(SymbolHandle symbol, const char **out_str);
+MXNET_DLL int MXSymbolGetAttr(SymbolHandle symbol, const char *key,
+                              const char **out, int *success);
+MXNET_DLL int MXSymbolSetAttr(SymbolHandle symbol, const char *key,
+                              const char *value);
+/*! \brief flat [k0,v0,k1,v1,...] attribute list, keys "node$attr" */
+MXNET_DLL int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                               const char ***out);
+MXNET_DLL int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out);
+MXNET_DLL int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index,
+                                SymbolHandle *out);
+/*! \brief bidirectional dtype inference; *complete==0 when underspecified */
+MXNET_DLL int MXSymbolInferType(SymbolHandle sym, mx_uint num_args,
+                                const char **keys, const int *arg_type_data,
+                                mx_uint *in_type_size, const int **in_type_data,
+                                mx_uint *out_type_size,
+                                const int **out_type_data,
+                                mx_uint *aux_type_size,
+                                const int **aux_type_data, int *complete);
+
+/*! \brief bidirectional shape inference (parity: MXSymbolInferShape).
+ *  Known arg shapes arrive CSR-style: keys[i]'s shape is
+ *  arg_shape_data[arg_ind_ptr[i] .. arg_ind_ptr[i+1]).  *complete==0 when
+ *  the graph is underspecified (all out sizes 0 in that case). */
+MXNET_DLL int MXSymbolInferShape(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete);
+
+/* -------------------------------------------------------------- Executor */
+/*! \brief bind a symbol into an executor (parity: MXExecutorBindEX,
+ *  reference c_api.h:1040; group2ctx maps are not supported over the C
+ *  boundary — bind with the Python frontend for model-parallel graphs).
+ *  arg_grad_store entries may be NULL (no gradient for that argument);
+ *  grad_req_type: 0=null 1=write 3=add. */
+MXNET_DLL int MXExecutorBind(SymbolHandle symbol_handle, int dev_type,
+                             int dev_id, mx_uint len,
+                             NDArrayHandle *in_args,
+                             NDArrayHandle *arg_grad_store,
+                             mx_uint *grad_req_type, mx_uint aux_states_len,
+                             NDArrayHandle *aux_states, ExecutorHandle *out);
+MXNET_DLL int MXExecutorFree(ExecutorHandle handle);
+MXNET_DLL int MXExecutorForward(ExecutorHandle handle, int is_train);
+/*! \brief run the backward pass; head_grads may be NULL/len 0 for loss ops */
+MXNET_DLL int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                                 NDArrayHandle *head_grads);
+MXNET_DLL int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                                NDArrayHandle **out);
+MXNET_DLL int MXExecutorPrint(ExecutorHandle handle, const char **out_str);
+
+/* --------------------------------------------------------------- KVStore */
+MXNET_DLL int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+MXNET_DLL int MXKVStoreFree(KVStoreHandle handle);
+MXNET_DLL int MXKVStoreInit(KVStoreHandle handle, mx_uint num,
+                            const int *keys, NDArrayHandle *vals);
+MXNET_DLL int MXKVStorePush(KVStoreHandle handle, mx_uint num,
+                            const int *keys, NDArrayHandle *vals,
+                            int priority);
+MXNET_DLL int MXKVStorePull(KVStoreHandle handle, mx_uint num,
+                            const int *keys, NDArrayHandle *vals,
+                            int priority);
+/*! \brief install a C updater applied at push time (parity:
+ *  MXKVStoreSetUpdater).  The updater is called synchronously with the
+ *  merged gradient and the stored weight. */
+MXNET_DLL int MXKVStoreSetUpdater(KVStoreHandle handle,
+                                  MXKVStoreUpdater updater,
+                                  void *updater_handle);
+MXNET_DLL int MXKVStoreGetType(KVStoreHandle handle, const char **type);
+MXNET_DLL int MXKVStoreGetRank(KVStoreHandle handle, int *rank);
+MXNET_DLL int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size);
+MXNET_DLL int MXKVStoreBarrier(KVStoreHandle handle);
+MXNET_DLL int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                            int barrier_before_exit);
+MXNET_DLL int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id,
+                                      int *number, int timeout_sec);
+/*! \brief reference spelling kept verbatim (c_api.h:1243).  ``body`` is a
+ *  NUL-terminated C string, so it must not contain embedded NUL bytes —
+ *  for head=0 (install optimizer) use pickle protocol 0, which is ASCII
+ *  (the reference's Python frontend relies on the same property). */
+MXNET_DLL int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int head,
+                                             const char *body);
+/*! \brief no-op on TPU: there are no parameter-server processes — the
+ *  dist_tpu kvstore is an SPMD allreduce (see mxnet_tpu/parallel/dist.py) */
+MXNET_DLL int MXKVStoreRunServer(KVStoreHandle handle);
+/*! \brief set DMLC_/MXTPU_ role environment variables (parity: MXInitPSEnv) */
+MXNET_DLL int MXInitPSEnv(mx_uint num_vars, const char **keys,
+                          const char **vals);
+
+/* -------------------------------------------------------------- DataIter */
+MXNET_DLL int MXListDataIters(mx_uint *out_size, DataIterCreator **out);
+MXNET_DLL int MXDataIterGetIterInfo(DataIterCreator creator,
+                                    const char **name,
+                                    const char **description);
+MXNET_DLL int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                                   const char **keys, const char **vals,
+                                   DataIterHandle *out);
+MXNET_DLL int MXDataIterFree(DataIterHandle handle);
+/*! \brief advance; *out = 1 if a batch is available, 0 at end of epoch */
+MXNET_DLL int MXDataIterNext(DataIterHandle handle, int *out);
+MXNET_DLL int MXDataIterBeforeFirst(DataIterHandle handle);
+MXNET_DLL int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+MXNET_DLL int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+MXNET_DLL int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+MXNET_DLL int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                                 uint64_t *out_size);
+
+/* -------------------------------------------------------------- Profiler */
+/*! \brief mode 0 = symbolic ops only, 1 = all ops */
+MXNET_DLL int MXSetProfilerConfig(int mode, const char *filename);
+/*! \brief state 1 = run, 0 = stop */
+MXNET_DLL int MXSetProfilerState(int state);
+MXNET_DLL int MXDumpProfile();
 
 /* -------------------------------------------------------------- RecordIO */
 typedef void *RecordIOHandle;

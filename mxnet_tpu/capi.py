@@ -83,24 +83,24 @@ def symbol_create_from_json(json_str):
 
 
 def symbol_save_to_json(handle):
-    return handle.tojson()
+    return _sym(handle).tojson()
 
 
 def symbol_list_arguments(handle):
-    return list(handle.list_arguments())
+    return list(_sym(handle).list_arguments())
 
 
 def symbol_list_outputs(handle):
-    return list(handle.list_outputs())
+    return list(_sym(handle).list_outputs())
 
 
 def symbol_list_auxiliary_states(handle):
-    return list(handle.list_auxiliary_states())
+    return list(_sym(handle).list_auxiliary_states())
 
 
 def symbol_infer_shape(handle, names, shapes):
     kwargs = {n: tuple(s) for n, s in zip(names, shapes)}
-    arg_shapes, out_shapes, aux_shapes = handle.infer_shape(**kwargs)
+    arg_shapes, out_shapes, aux_shapes = _sym(handle).infer_shape(**kwargs)
     if arg_shapes is None:
         return None
     return (tuple(map(tuple, arg_shapes)), tuple(map(tuple, out_shapes)),
@@ -147,6 +147,384 @@ def pred_get_output(pred, index):
 # ------------------------------------------------------------------- random
 def random_seed(seed):
     _random.seed(int(seed))
+
+
+# -------------------------------------------------- NDArray (extended surface)
+_DTYPE_CODE = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+               4: "int32", 5: "int8", 6: "int64"}
+_DTYPE_RCODE = {v: k for k, v in _DTYPE_CODE.items()}
+
+
+def nd_create_ex(shape, dev_type, dev_id, dtype_code):
+    dt = _DTYPE_CODE.get(int(dtype_code), "float32")
+    return nd.zeros(tuple(int(x) for x in shape), ctx=_ctx(dev_type, dev_id),
+                    dtype=_np.dtype(dt))
+
+
+def nd_get_dtype(handle):
+    name = _np.dtype(handle.dtype).name
+    return int(_DTYPE_RCODE.get(name, 0))
+
+
+def nd_get_context(handle):
+    ctx = handle.context
+    code = {v: k for k, v in _DEVTYPE.items()}.get(ctx.device_type, 1)
+    return int(code), int(ctx.device_id)
+
+
+def nd_slice(handle, begin, end):
+    return handle[int(begin):int(end)]
+
+
+def nd_at(handle, idx):
+    return handle[int(idx)]
+
+
+def nd_reshape(handle, shape):
+    return handle.reshape(tuple(int(x) for x in shape))
+
+
+def nd_sync_copy_from_typed(handle, data):
+    arr = _np.frombuffer(data, dtype=handle.dtype).reshape(handle.shape)
+    handle[:] = arr
+
+
+def nd_sync_copy_to_typed(handle):
+    return _np.ascontiguousarray(handle.asnumpy()).tobytes()
+
+
+# ------------------------------------------------- op reflection + imperative
+def _op_registry():
+    from .ops import registry
+    return registry
+
+
+def atomic_symbol_info(op_name):
+    """(name, doc, arg_names, arg_types, arg_descs, key_var_num_args) —
+    parity: MXSymbolGetAtomicSymbolInfo (reference c_api.h:563); feeds
+    cpp-package op.h autogeneration."""
+    op = _op_registry().get_op(str(op_name))
+    params = op.normalize_attrs({})
+    arg_names = []
+    arg_types = []
+    arg_descs = []
+    for n in op.arg_names_for(params):
+        arg_names.append(n)
+        arg_types.append("NDArray-or-Symbol")
+        arg_descs.append("input: %s" % n)
+    for k in sorted(op.attr_types):
+        arg_names.append(k)
+        default = op.defaults.get(k)
+        arg_types.append("string, optional, default='%s'" % (default,)
+                         if k in op.defaults else "string, required")
+        arg_descs.append("attribute %s" % k)
+    return (op.name, op.doc or "", arg_names, arg_types, arg_descs,
+            op.key_var_num_args or "")
+
+
+def imperative_invoke(op_name, input_handles, keys, vals, out_handles):
+    """Run one op eagerly on NDArray handles (parity: MXImperativeInvoke,
+    reference src/c_api/c_api_ndarray.cc:323).  Returns the output NDArrays
+    (new, or the provided ``out_handles`` written in place)."""
+    attrs = dict(zip(keys, vals))
+    from .ndarray import _invoke
+    from .ops.registry import get_op
+    if out_handles:
+        op = get_op(str(op_name))
+        n_vis = op.num_outputs_for(op.normalize_attrs(attrs))
+        if len(out_handles) != n_vis:
+            raise ValueError("op %s has %d outputs, got %d out handles"
+                             % (op_name, n_vis, len(out_handles)))
+    outs = _invoke(str(op_name), list(input_handles), attrs,
+                   out=list(out_handles) if out_handles else None)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return list(outs)
+
+
+# ------------------------------------------------- Symbol (extended surface)
+class _AtomicStub(object):
+    """MXSymbolCreateAtomicSymbol's product: an op + params awaiting Compose
+    (the reference mutates the symbol in place at MXSymbolCompose; the C
+    handle keeps pointing at this stub, which swaps in the composed graph)."""
+
+    def __init__(self, op_name, params):
+        self.op_name = op_name
+        self.params = params
+        self.sym = None
+
+
+def _sym(handle):
+    if isinstance(handle, _AtomicStub):
+        if handle.sym is None:
+            raise ValueError("symbol %s not composed yet" % handle.op_name)
+        return handle.sym
+    return handle
+
+
+def symbol_create_atomic(op_name, keys, vals):
+    return _AtomicStub(str(op_name), dict(zip(keys, vals)))
+
+
+def symbol_create_variable(name):
+    return sym_mod.Variable(str(name))
+
+
+def symbol_create_group(handles):
+    return sym_mod.Group([_sym(h) for h in handles])
+
+
+def symbol_compose(handle, name, keys, arg_handles):
+    """parity: MXSymbolCompose (in-place on the handle)."""
+    args = [_sym(h) for h in arg_handles]
+    if not isinstance(handle, _AtomicStub):
+        raise ValueError("can only compose an atomic symbol")
+    kwargs = dict(handle.params)
+    if name:
+        kwargs["name"] = str(name)
+    if keys:
+        named = dict(zip(keys, args))
+        handle.sym = sym_mod.create(handle.op_name, **named, **kwargs)
+    else:
+        handle.sym = sym_mod.create(handle.op_name, *args, **kwargs)
+    return None
+
+
+def symbol_copy(handle):
+    return sym_mod.load_json(_sym(handle).tojson())
+
+
+def symbol_print(handle):
+    return _sym(handle).debug_str()
+
+
+def symbol_get_attr(handle, key):
+    v = _sym(handle).attr(str(key))
+    return v if v is not None else None
+
+
+def symbol_set_attr(handle, key, value):
+    _sym(handle)._set_attr(**{str(key): str(value)})
+
+
+def symbol_get_internals(handle):
+    return _sym(handle).get_internals()
+
+
+def symbol_get_output(handle, index):
+    return _sym(handle)[int(index)]
+
+
+def symbol_list_attr(handle):
+    out = []
+    for k, v in sorted(_sym(handle).attr_dict().items()):
+        if isinstance(v, dict):
+            for kk, vv in sorted(v.items()):
+                out.append("%s$%s" % (k, kk))
+                out.append(str(vv))
+    return out
+
+
+def symbol_infer_type(handle, names, dtype_codes):
+    kwargs = {n: _np.dtype(_DTYPE_CODE.get(int(c), "float32"))
+              for n, c in zip(names, dtype_codes)}
+    arg_t, out_t, aux_t = _sym(handle).infer_type(**kwargs)
+    if arg_t is None:
+        return None
+
+    def codes(ts):
+        return [int(_DTYPE_RCODE.get(_np.dtype(t).name, 0)) for t in ts]
+    return codes(arg_t), codes(out_t), codes(aux_t)
+
+
+# ---------------------------------------------------------------- Executor
+_GRAD_REQ = {0: "null", 1: "write", 3: "add"}
+
+
+def executor_bind(handle, dev_type, dev_id, arg_handles, grad_handles,
+                  grad_req_codes, aux_handles):
+    """parity: MXExecutorBindEX (reference c_api.h:1040)."""
+    symbol = _sym(handle)
+    ctx = _ctx(dev_type, dev_id)
+    args = list(arg_handles)
+    grads = [g if g is not None else None for g in grad_handles] \
+        if grad_handles else None
+    reqs = [_GRAD_REQ.get(int(c), "null") for c in grad_req_codes]
+    aux = list(aux_handles) if aux_handles else None
+    return symbol.bind(ctx, args=args, args_grad=grads, grad_req=reqs,
+                       aux_states=aux)
+
+
+def executor_forward(ex, is_train):
+    ex.forward(is_train=bool(is_train))
+
+
+def executor_backward(ex, head_grad_handles):
+    if head_grad_handles:
+        ex.backward(list(head_grad_handles))
+    else:
+        ex.backward()
+
+
+def executor_outputs(ex):
+    return list(ex.outputs)
+
+
+def executor_print(ex):
+    return "Executor(symbol=%s)" % (ex._symbol.name or "Grouped")
+
+
+# ----------------------------------------------------------------- KVStore
+def kvstore_create(kv_type):
+    from . import kvstore as kv_mod
+    return kv_mod.create(str(kv_type))
+
+
+def kvstore_init(kv, keys, nd_handles):
+    kv.init(list(keys), list(nd_handles))
+
+
+def kvstore_push(kv, keys, nd_handles, priority):
+    kv.push(list(keys), list(nd_handles), priority=int(priority))
+
+
+def kvstore_pull(kv, keys, nd_handles, priority):
+    kv.pull(list(keys), out=list(nd_handles), priority=int(priority))
+
+
+def kvstore_set_updater(kv, fn, capsule):
+    """``fn`` is the native call_updater bridge (see NativeCallUpdater in
+    src/c_api/c_api.cc) and ``capsule`` wraps the user's C function pointer;
+    the kvstore updater protocol is updater(key, recv_grad, stored_weight)."""
+    kv.set_updater(lambda key, recv, local: fn(capsule, int(key), recv,
+                                               local))
+
+
+def kvstore_get_type(kv):
+    return kv.type
+
+
+def kvstore_get_rank(kv):
+    return int(kv.rank)
+
+
+def kvstore_get_group_size(kv):
+    return int(kv.num_workers)
+
+
+def kvstore_barrier(kv):
+    kv.barrier()
+
+
+def kvstore_set_barrier_before_exit(kv, flag):
+    kv.set_barrier_before_exit(bool(flag))
+
+
+def kvstore_get_num_dead_node(kv, node_id, timeout):
+    return int(kv.num_dead_node(int(node_id), int(timeout)))
+
+
+def kvstore_send_command_to_servers(kv, head, body):
+    kv._send_command_to_servers(int(head), body)
+
+
+# ---------------------------------------------------------------- DataIter
+_DATA_ITERS = ("MNISTIter", "ImageRecordIter", "CSVIter")
+
+
+def list_data_iters():
+    return list(_DATA_ITERS)
+
+
+def data_iter_info(name):
+    from . import io as io_mod
+    from . import image as image_mod
+    cls = getattr(image_mod if name == "ImageRecordIter" else io_mod, name)
+    return (str(name), cls.__doc__ or "")
+
+
+def _parse_iter_val(v):
+    v = str(v)
+    try:
+        import ast
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        if v in ("True", "true"):
+            return True
+        if v in ("False", "false"):
+            return False
+        return v
+
+
+def data_iter_create(name, keys, vals):
+    from . import io as io_mod
+    from . import image as image_mod
+    name = str(name)
+    if name not in _DATA_ITERS:
+        raise ValueError("unknown data iter %s" % name)
+    cls = getattr(image_mod if name == "ImageRecordIter" else io_mod, name)
+    kwargs = {k: _parse_iter_val(v) for k, v in zip(keys, vals)}
+    return _CApiIter(cls(**kwargs))
+
+
+class _CApiIter(object):
+    """Wraps a DataIter for the C boundary: Next() caches the batch so
+    GetData/GetLabel/GetPadNum refer to the batch Next just returned
+    (parity: MXDataIterNext/GetData/GetLabel, reference c_api.h:1079+)."""
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+
+def data_iter_next(handle):
+    try:
+        handle.batch = next(handle.it)
+        return 1
+    except StopIteration:
+        handle.batch = None
+        return 0
+
+
+def data_iter_before_first(handle):
+    handle.it.reset()
+    handle.batch = None
+
+
+def data_iter_get_data(handle):
+    return handle.batch.data[0]
+
+
+def data_iter_get_label(handle):
+    return handle.batch.label[0]
+
+
+def data_iter_get_pad_num(handle):
+    return int(handle.batch.pad or 0)
+
+
+def data_iter_get_index(handle):
+    idx = getattr(handle.batch, "index", None)
+    if idx is None:
+        return []
+    return [int(i) for i in idx]
+
+
+# ---------------------------------------------------------------- profiler
+def profiler_set_config(mode, filename):
+    from . import profiler
+    profiler.set_config("all" if int(mode) > 0 else "symbolic",
+                        str(filename))
+
+
+def profiler_set_state(state):
+    from . import profiler
+    profiler.set_state("run" if int(state) == 1 else "stop")
+
+
+def profiler_dump():
+    from . import profiler
+    profiler.dump_profile()
 
 
 # ------------------------------------------------------------------ recordio

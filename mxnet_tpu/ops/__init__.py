@@ -36,19 +36,18 @@ _LAYOUT_TRANSPARENT = [
     "relu", "sigmoid", "tanh", "exp", "log", "negative", "abs", "sign",
     "square", "sqrt", "rsqrt", "_copy", "BlockGrad", "Cast", "Dropout",
     "Activation", "clip",
-    # binary elementwise (same-shape; residual adds)
-    "_Plus", "_Minus", "_Mul", "_Div", "_Maximum", "_Minimum",
-    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    # binary elementwise (same-shape; residual adds).  elemwise_add etc. are
+    # aliases sharing the _plus/_minus/... OpDef objects
+    "_plus", "_minus", "_mul", "_div", "_maximum", "_minimum",
     "add_n",
     # scalar variants
-    "_PlusScalar", "_MinusScalar", "_RMinusScalar", "_MulScalar",
-    "_DivScalar", "_RDivScalar", "_MaximumScalar", "_MinimumScalar",
+    "_plus_scalar", "_minus_scalar", "_rminus_scalar", "_mul_scalar",
+    "_div_scalar", "_rdiv_scalar", "_maximum_scalar", "_minimum_scalar",
 ]
 for _name in _LAYOUT_TRANSPARENT:
-    try:
-        get_op(_name).layout_rule = "transparent"
-    except Exception:
-        pass
+    # a typo here must fail loudly — a silently-rigid op would make the NHWC
+    # pass insert transposes around it, an unmeasured perf regression
+    get_op(_name).layout_rule = "transparent"
 # LeakyReLU: transparent except prelu (whose gamma broadcasts over axis 1)
 get_op("LeakyReLU").layout_rule = (
     lambda attrs: None if attrs.get("act_type") == "prelu" else "transparent")

@@ -15,6 +15,7 @@
  */
 #include <Python.h>
 
+#include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -35,6 +36,18 @@ struct ThreadLocalScratch {
   std::vector<mx_uint> shape;
   std::string json;
   std::vector<void *> handles;
+  std::vector<int> in_types, out_types, aux_types;
+  std::vector<uint64_t> index;
+  /* shape-inference result arenas (three groups alive simultaneously) */
+  struct ShapeArena {
+    std::vector<std::vector<mx_uint>> dims;
+    std::vector<mx_uint> ndims;
+    std::vector<const mx_uint *> ptrs;
+  } shapes_in, shapes_out, shapes_aux;
+  /* second string-list arena: GetAtomicSymbolInfo returns three lists that
+   * must stay alive simultaneously */
+  std::vector<std::string> strings2, strings3;
+  std::vector<const char *> cstrs2, cstrs3;
 };
 thread_local ThreadLocalScratch scratch;
 
@@ -115,23 +128,109 @@ PyObject *ShapeTuple(const mx_uint *shape, mx_uint ndim) {
   return t;
 }
 
-int StrListOut(PyObject *list, mx_uint *out_size, const char ***out_array) {
+/* Marshal a python string list into an arena that outlives the call (the
+ * reference uses MXAPIThreadLocalEntry identically).  Fails cleanly on a
+ * non-string / non-UTF8-encodable element. */
+int StrListOutArena(PyObject *list, mx_uint *out_size,
+                    const char ***out_array,
+                    std::vector<std::string> *strs,
+                    std::vector<const char *> *cstrs) {
   Py_ssize_t n = PyList_Size(list);
-  scratch.strings.clear();
-  scratch.cstrs.clear();
+  strs->clear();
+  cstrs->clear();
   for (Py_ssize_t i = 0; i < n; ++i) {
     const char *s = PyUnicode_AsUTF8(PyList_GetItem(list, i));
-    if (s == nullptr) {  // non-string or non-UTF8-encodable element
+    if (s == nullptr) {
       last_error = FetchPyError();
       return -1;
     }
-    scratch.strings.emplace_back(s);
+    strs->emplace_back(s);
   }
-  for (auto &s : scratch.strings) scratch.cstrs.push_back(s.c_str());
+  for (auto &s : *strs) cstrs->push_back(s.c_str());
   *out_size = static_cast<mx_uint>(n);
-  *out_array = scratch.cstrs.data();
+  *out_array = cstrs->data();
   return 0;
 }
+
+int StrListOut(PyObject *list, mx_uint *out_size, const char ***out_array) {
+  return StrListOutArena(list, out_size, out_array, &scratch.strings,
+                         &scratch.cstrs);
+}
+
+/* Python list from NDArrayHandle array; NULL entries become None. */
+PyObject *NDList(mx_uint n, NDArrayHandle *h) {
+  PyObject *l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyObject *o = (h != nullptr && h[i] != nullptr)
+        ? reinterpret_cast<PyObject *>(h[i]) : Py_None;
+    Py_INCREF(o);
+    PyList_SET_ITEM(l, i, o);
+  }
+  return l;
+}
+
+PyObject *StrList(mx_uint n, const char **s) {
+  PyObject *l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyList_SET_ITEM(l, i, PyUnicode_FromString(s != nullptr ? s[i] : ""));
+  }
+  return l;
+}
+
+PyObject *IntList(mx_uint n, const int *v) {
+  PyObject *l = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyList_SET_ITEM(l, i, PyLong_FromLong(v[i]));
+  }
+  return l;
+}
+
+/* Copy a python list of NDArrays out as INCREF'd handles in scratch. */
+int HandleListOut(PyObject *list, mx_uint *out_size, NDArrayHandle **out) {
+  Py_ssize_t n = PyList_Size(list);
+  scratch.handles.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *o = PyList_GetItem(list, i);
+    Py_INCREF(o);
+    scratch.handles.push_back(o);
+  }
+  *out_size = static_cast<mx_uint>(n);
+  *out = scratch.handles.data();
+  return 0;
+}
+
+/* ------------------------------------------- KVStore updater C trampoline */
+struct UpdaterClosure {
+  MXKVStoreUpdater fn;
+  void *handle;
+};
+
+void FreeUpdaterClosure(PyObject *cap) {
+  delete reinterpret_cast<UpdaterClosure *>(
+      PyCapsule_GetPointer(cap, "mxtpu_updater"));
+}
+
+PyObject *NativeCallUpdater(PyObject *, PyObject *args) {
+  PyObject *cap = nullptr, *recv = nullptr, *local = nullptr;
+  int key = 0;
+  if (!PyArg_ParseTuple(args, "OiOO", &cap, &key, &recv, &local)) {
+    return nullptr;
+  }
+  auto *c = reinterpret_cast<UpdaterClosure *>(
+      PyCapsule_GetPointer(cap, "mxtpu_updater"));
+  if (c == nullptr) return nullptr;
+  /* synchronous call back into user C code; the MX* APIs it invokes
+   * re-enter PyGILState_Ensure recursively on this thread, which is safe */
+  c->fn(key, reinterpret_cast<NDArrayHandle>(recv),
+        reinterpret_cast<NDArrayHandle>(local), c->handle);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_updater_def = {"call_updater", NativeCallUpdater, METH_VARARGS,
+                             "bridge from python kvstore to the C updater"};
+
+/* stable operator-creator handles (PyUnicode op names, never freed) */
+std::vector<PyObject *> g_creators;
 
 }  // namespace
 
@@ -409,6 +508,869 @@ int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
                                 const char ***out_array) {
   return SymbolStrList("symbol_list_auxiliary_states", symbol, out_size,
                        out_array);
+}
+
+/* ------------------------------------------------- NDArray (extended) */
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out) {
+  (void)delay_alloc;
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(Niii)", ShapeTuple(shape, ndim), dev_type,
+                                 dev_id, dtype);
+  PyObject *r = CallShim("nd_create_ex", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("nd_get_dtype", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out_dtype = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("nd_get_context", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint begin, mx_uint end,
+                   NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(OII)",
+                                 reinterpret_cast<PyObject *>(handle),
+                                 begin, end);
+  PyObject *r = CallShim("nd_slice", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(OI)",
+                                 reinterpret_cast<PyObject *>(handle), idx);
+  PyObject *r = CallShim("nd_at", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int *dims,
+                     NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *shape = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(shape, i, PyLong_FromLong(dims[i]));
+  }
+  PyObject *args = Py_BuildValue("(ON)",
+                                 reinterpret_cast<PyObject *>(handle), shape);
+  PyObject *r = CallShim("nd_reshape", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXNDArraySyncCopyFromCPUEx(NDArrayHandle handle, const void *data,
+                               size_t nbytes) {
+  API_BEGIN();
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(data), nbytes);
+  PyObject *args = Py_BuildValue("(ON)",
+                                 reinterpret_cast<PyObject *>(handle), bytes);
+  PyObject *r = CallShim("nd_sync_copy_from_typed", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArraySyncCopyToCPUEx(NDArrayHandle handle, void *data,
+                             size_t nbytes) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("nd_sync_copy_to_typed", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(r, &buf, &len);
+  if (static_cast<size_t>(len) != nbytes) {
+    Py_DECREF(r);
+    last_error = "MXNDArraySyncCopyToCPUEx: size mismatch (array has " +
+                 std::to_string(len) + " bytes, caller passed " +
+                 std::to_string(nbytes) + ")";
+    return -1;
+  }
+  std::memcpy(data, buf, nbytes);
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ------------------------------------------- op reflection + imperative */
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out) {
+  API_BEGIN();
+  if (g_creators.empty()) {
+    PyObject *r = CallShim("list_all_op_names", nullptr);
+    CHECK_PY(r);
+    Py_ssize_t n = PyList_Size(r);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *s = PyList_GetItem(r, i);
+      Py_INCREF(s);          // creator handles are stable for process life
+      g_creators.push_back(s);
+    }
+    Py_DECREF(r);
+  }
+  *out_size = static_cast<mx_uint>(g_creators.size());
+  *out = reinterpret_cast<AtomicSymbolCreator *>(g_creators.data());
+  API_END();
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name) {
+  API_BEGIN();
+  const char *s = PyUnicode_AsUTF8(reinterpret_cast<PyObject *>(creator));
+  if (s == nullptr) {
+    last_error = FetchPyError();
+    return -1;
+  }
+  scratch.json = s;
+  *name = scratch.json.c_str();
+  API_END();
+}
+
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char **name, const char **description,
+                                mx_uint *num_args, const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(creator));
+  PyObject *r = CallShim("atomic_symbol_info", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  static thread_local std::string nm, doc, kv;
+  nm = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  doc = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  kv = PyUnicode_AsUTF8(PyTuple_GetItem(r, 5));
+  mx_uint n1 = 0, n2 = 0, n3 = 0;
+  if (StrListOut(PyTuple_GetItem(r, 2), &n1, arg_names) != 0 ||
+      StrListOutArena(PyTuple_GetItem(r, 3), &n2, arg_type_infos,
+                      &scratch.strings2, &scratch.cstrs2) != 0 ||
+      StrListOutArena(PyTuple_GetItem(r, 4), &n3, arg_descriptions,
+                      &scratch.strings3, &scratch.cstrs3) != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
+  Py_DECREF(r);
+  *name = nm.c_str();
+  *description = doc.c_str();
+  *key_var_num_args = kv.c_str();
+  *num_args = n1;
+  API_END();
+}
+
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals) {
+  API_BEGIN();
+  PyObject *outs_in = (*num_outputs > 0 && *outputs != nullptr)
+      ? NDList(*num_outputs, *outputs) : PyList_New(0);
+  PyObject *args = Py_BuildValue(
+      "(ONNNN)", reinterpret_cast<PyObject *>(creator),
+      NDList(num_inputs, inputs), StrList(num_params, param_keys),
+      StrList(num_params, param_vals), outs_in);
+  PyObject *r = CallShim("imperative_invoke", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  if (*num_outputs > 0 && *outputs != nullptr) {
+    /* outputs were written in place; handles unchanged */
+    Py_DECREF(r);
+  } else {
+    mx_uint n = 0;
+    HandleListOut(r, &n, reinterpret_cast<NDArrayHandle **>(outputs));
+    Py_DECREF(r);
+    *num_outputs = static_cast<int>(n);
+  }
+  API_END();
+}
+
+/* ---------------------------------------------------- Symbol (extended) */
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(ONN)",
+                                 reinterpret_cast<PyObject *>(creator),
+                                 StrList(num_param, keys),
+                                 StrList(num_param, vals));
+  PyObject *r = CallShim("symbol_create_atomic", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(s)", name);
+  PyObject *r = CallShim("symbol_create_variable", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(N)", NDList(num_symbols, symbols));
+  PyObject *r = CallShim("symbol_create_group", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args_h) {
+  API_BEGIN();
+  PyObject *key_list = (keys != nullptr) ? StrList(num_args, keys)
+                                         : PyList_New(0);
+  PyObject *args = Py_BuildValue("(OsNN)", reinterpret_cast<PyObject *>(sym),
+                                 name != nullptr ? name : "",
+                                 key_list, NDList(num_args, args_h));
+  PyObject *r = CallShim("symbol_compose", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(symbol));
+  PyObject *r = CallShim("symbol_copy", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXSymbolPrint(SymbolHandle symbol, const char **out_str) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(symbol));
+  PyObject *r = CallShim("symbol_print", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  scratch.json = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out_str = scratch.json.c_str();
+  API_END();
+}
+
+int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
+                    int *success) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(Os)", reinterpret_cast<PyObject *>(symbol),
+                                 key);
+  PyObject *r = CallShim("symbol_get_attr", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  if (r == Py_None) {
+    *success = 0;
+    *out = nullptr;
+  } else {
+    scratch.json = PyUnicode_AsUTF8(r);
+    *out = scratch.json.c_str();
+    *success = 1;
+  }
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolSetAttr(SymbolHandle symbol, const char *key, const char *value) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(Oss)", reinterpret_cast<PyObject *>(symbol),
+                                 key, value);
+  PyObject *r = CallShim("symbol_set_attr", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                     const char ***out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(symbol));
+  PyObject *r = CallShim("symbol_list_attr", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  mx_uint n = 0;
+  if (StrListOut(r, &n, out) != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
+  Py_DECREF(r);
+  *out_size = n / 2;  // reference convention: pairs, size = pair count
+  API_END();
+}
+
+int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(symbol));
+  PyObject *r = CallShim("symbol_get_internals", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(OI)", reinterpret_cast<PyObject *>(symbol),
+                                 index);
+  PyObject *r = CallShim("symbol_get_output", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char **keys,
+                      const int *arg_type_data, mx_uint *in_type_size,
+                      const int **in_type_data, mx_uint *out_type_size,
+                      const int **out_type_data, mx_uint *aux_type_size,
+                      const int **aux_type_data, int *complete) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(ONN)", reinterpret_cast<PyObject *>(sym),
+                                 StrList(num_args, keys),
+                                 IntList(num_args, arg_type_data));
+  PyObject *r = CallShim("symbol_infer_type", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  if (r == Py_None) {
+    *complete = 0;
+    *in_type_size = *out_type_size = *aux_type_size = 0;
+    Py_DECREF(r);
+    return 0;
+  }
+  auto fill = [](PyObject *list, std::vector<int> *dst, mx_uint *size,
+                 const int **data) {
+    Py_ssize_t n = PyList_Size(list);
+    dst->clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      dst->push_back(static_cast<int>(PyLong_AsLong(PyList_GetItem(list, i))));
+    }
+    *size = static_cast<mx_uint>(n);
+    *data = dst->data();
+  };
+  fill(PyTuple_GetItem(r, 0), &scratch.in_types, in_type_size, in_type_data);
+  fill(PyTuple_GetItem(r, 1), &scratch.out_types, out_type_size,
+       out_type_data);
+  fill(PyTuple_GetItem(r, 2), &scratch.aux_types, aux_type_size,
+       aux_type_data);
+  *complete = 1;
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
+                       const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size, const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete) {
+  API_BEGIN();
+  PyObject *names = StrList(num_args, keys);
+  PyObject *shapes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject *t = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyTuple_SET_ITEM(t, j - lo, PyLong_FromUnsignedLong(arg_shape_data[j]));
+    }
+    PyList_SET_ITEM(shapes, i, t);
+  }
+  PyObject *args = Py_BuildValue("(ONN)", reinterpret_cast<PyObject *>(sym),
+                                 names, shapes);
+  PyObject *r = CallShim("symbol_infer_shape", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  if (r == Py_None) {
+    *complete = 0;
+    *in_shape_size = *out_shape_size = *aux_shape_size = 0;
+    Py_DECREF(r);
+    return 0;
+  }
+  auto fill = [](PyObject *tup, ThreadLocalScratch::ShapeArena *a,
+                 mx_uint *size, const mx_uint **ndim,
+                 const mx_uint ***data) {
+    Py_ssize_t n = PyTuple_Size(tup);
+    a->dims.assign(n, {});
+    a->ndims.clear();
+    a->ptrs.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *s = PyTuple_GetItem(tup, i);
+      Py_ssize_t d = PyTuple_Size(s);
+      for (Py_ssize_t j = 0; j < d; ++j) {
+        a->dims[i].push_back(static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyTuple_GetItem(s, j))));
+      }
+      a->ndims.push_back(static_cast<mx_uint>(d));
+    }
+    for (auto &v : a->dims) a->ptrs.push_back(v.data());
+    *size = static_cast<mx_uint>(n);
+    *ndim = a->ndims.data();
+    *data = a->ptrs.data();
+  };
+  fill(PyTuple_GetItem(r, 0), &scratch.shapes_in, in_shape_size,
+       in_shape_ndim, in_shape_data);
+  fill(PyTuple_GetItem(r, 1), &scratch.shapes_out, out_shape_size,
+       out_shape_ndim, out_shape_data);
+  fill(PyTuple_GetItem(r, 2), &scratch.shapes_aux, aux_shape_size,
+       aux_shape_ndim, aux_shape_data);
+  *complete = 1;
+  Py_DECREF(r);
+  API_END();
+}
+
+/* ---------------------------------------------------------------- Executor */
+int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out) {
+  API_BEGIN();
+  PyObject *reqs = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i) {
+    PyList_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(grad_req_type[i]));
+  }
+  PyObject *args = Py_BuildValue(
+      "(OiiNNNN)", reinterpret_cast<PyObject *>(symbol_handle), dev_type,
+      dev_id, NDList(len, in_args), NDList(len, arg_grad_store), reqs,
+      NDList(aux_states_len, aux_states));
+  PyObject *r = CallShim("executor_bind", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXExecutorFree(ExecutorHandle handle) {
+  API_BEGIN();
+  Py_XDECREF(reinterpret_cast<PyObject *>(handle));
+  API_END();
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(Oi)", reinterpret_cast<PyObject *>(handle),
+                                 is_train);
+  PyObject *r = CallShim("executor_forward", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(ON)", reinterpret_cast<PyObject *>(handle),
+                                 NDList(len, head_grads));
+  PyObject *r = CallShim("executor_backward", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("executor_outputs", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  HandleListOut(r, out_size, out);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("executor_print", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  scratch.json = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out_str = scratch.json.c_str();
+  API_END();
+}
+
+/* ----------------------------------------------------------------- KVStore */
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(s)", type);
+  PyObject *r = CallShim("kvstore_create", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXKVStoreFree(KVStoreHandle handle) {
+  API_BEGIN();
+  Py_XDECREF(reinterpret_cast<PyObject *>(handle));
+  API_END();
+}
+
+static PyObject *KVKeyList(mx_uint num, const int *keys) {
+  PyObject *l = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    PyList_SET_ITEM(l, i, PyLong_FromLong(keys[i]));
+  }
+  return l;
+}
+
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(ONN)", reinterpret_cast<PyObject *>(handle),
+                                 KVKeyList(num, keys), NDList(num, vals));
+  PyObject *r = CallShim("kvstore_init", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(ONNi)",
+                                 reinterpret_cast<PyObject *>(handle),
+                                 KVKeyList(num, keys), NDList(num, vals),
+                                 priority);
+  PyObject *r = CallShim("kvstore_push", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(ONNi)",
+                                 reinterpret_cast<PyObject *>(handle),
+                                 KVKeyList(num, keys), NDList(num, vals),
+                                 priority);
+  PyObject *r = CallShim("kvstore_pull", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle) {
+  API_BEGIN();
+  auto *closure = new UpdaterClosure{updater, updater_handle};
+  PyObject *cap = PyCapsule_New(closure, "mxtpu_updater", FreeUpdaterClosure);
+  if (cap == nullptr) {
+    delete closure;
+    last_error = FetchPyError();
+    return -1;
+  }
+  PyObject *fn = PyCFunction_New(&g_updater_def, nullptr);
+  PyObject *args = Py_BuildValue("(ONN)",
+                                 reinterpret_cast<PyObject *>(handle), fn,
+                                 cap);
+  PyObject *r = CallShim("kvstore_set_updater", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreGetType(KVStoreHandle handle, const char **type) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("kvstore_get_type", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  scratch.json = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *type = scratch.json.c_str();
+  API_END();
+}
+
+int MXKVStoreGetRank(KVStoreHandle handle, int *rank) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("kvstore_get_rank", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *rank = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("kvstore_get_group_size", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *size = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreBarrier(KVStoreHandle handle) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("kvstore_barrier", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  int barrier_before_exit) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(Oi)", reinterpret_cast<PyObject *>(handle),
+                                 barrier_before_exit);
+  PyObject *r = CallShim("kvstore_set_barrier_before_exit", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int *number,
+                            int timeout_sec) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(Oii)", reinterpret_cast<PyObject *>(handle),
+                                 node_id, timeout_sec);
+  PyObject *r = CallShim("kvstore_get_num_dead_node", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *number = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int head,
+                                   const char *body) {
+  API_BEGIN();
+  PyObject *payload = PyBytes_FromString(body != nullptr ? body : "");
+  PyObject *args = Py_BuildValue("(OiN)",
+                                 reinterpret_cast<PyObject *>(handle), head,
+                                 payload);
+  PyObject *r = CallShim("kvstore_send_command_to_servers", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXKVStoreRunServer(KVStoreHandle handle) {
+  (void)handle;  // SPMD allreduce kvstore: no server processes to run
+  return 0;
+}
+
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals) {
+  API_BEGIN();
+  for (mx_uint i = 0; i < num_vars; ++i) {
+    setenv(keys[i], vals[i], 1);
+  }
+  API_END();
+}
+
+/* ---------------------------------------------------------------- DataIter */
+int MXListDataIters(mx_uint *out_size, DataIterCreator **out) {
+  API_BEGIN();
+  static std::vector<PyObject *> iters;  // stable creator handles
+  if (iters.empty()) {
+    PyObject *r = CallShim("list_data_iters", nullptr);
+    CHECK_PY(r);
+    Py_ssize_t n = PyList_Size(r);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *s = PyList_GetItem(r, i);
+      Py_INCREF(s);
+      iters.push_back(s);
+    }
+    Py_DECREF(r);
+  }
+  *out_size = static_cast<mx_uint>(iters.size());
+  *out = reinterpret_cast<DataIterCreator *>(iters.data());
+  API_END();
+}
+
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(creator));
+  PyObject *r = CallShim("data_iter_info", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  static thread_local std::string nm, doc;
+  nm = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  doc = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  *name = nm.c_str();
+  *description = doc.c_str();
+  API_END();
+}
+
+int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(ONN)",
+                                 reinterpret_cast<PyObject *>(creator),
+                                 StrList(num_param, keys),
+                                 StrList(num_param, vals));
+  PyObject *r = CallShim("data_iter_create", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXDataIterFree(DataIterHandle handle) {
+  API_BEGIN();
+  Py_XDECREF(reinterpret_cast<PyObject *>(handle));
+  API_END();
+}
+
+int MXDataIterNext(DataIterHandle handle, int *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("data_iter_next", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("data_iter_before_first", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("data_iter_get_data", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("data_iter_get_label", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("data_iter_get_pad_num", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *pad = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("data_iter_get_index", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_ssize_t n = PyList_Size(r);
+  scratch.index.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    scratch.index.push_back(PyLong_AsUnsignedLongLong(PyList_GetItem(r, i)));
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<uint64_t>(n);
+  *out_index = scratch.index.data();
+  API_END();
+}
+
+/* ---------------------------------------------------------------- Profiler */
+int MXSetProfilerConfig(int mode, const char *filename) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(is)", mode, filename);
+  PyObject *r = CallShim("profiler_set_config", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSetProfilerState(int state) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(i)", state);
+  PyObject *r = CallShim("profiler_set_state", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXDumpProfile() {
+  API_BEGIN();
+  PyObject *r = CallShim("profiler_dump", nullptr);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
 }
 
 /* ---------------------------------------------------------------- RecordIO */

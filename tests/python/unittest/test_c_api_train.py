@@ -1,0 +1,378 @@
+"""End-to-end training THROUGH the C API only (VERDICT r2 #2 done-criterion):
+symbol composition -> bind -> forward -> backward -> kvstore push/pull with a
+C updater -> converged MLP, without touching the Python frontend.  Numpy is
+used only to fabricate data and check results; every framework operation goes
+through libmxnet_tpu.so via ctypes (the same surface the reference exposes in
+include/mxnet/c_api.h: imperative invoke c_api.h:510, executor c_api.h:970-
+1077, op reflection c_api.h:563, data iters c_api.h:1079, kvstore c_api.h:1178).
+"""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+from test_c_api import LIB, libmx, _check  # noqa: F401  (fixture reuse)
+
+c_uint_p = ctypes.POINTER(ctypes.c_uint)
+c_int_p = ctypes.POINTER(ctypes.c_int)
+Handle = ctypes.c_void_p
+
+
+def _strs(*vals):
+    arr = (ctypes.c_char_p * len(vals))()
+    arr[:] = [v.encode() for v in vals]
+    return arr
+
+
+def _nd_create(lib, shape):
+    h = Handle()
+    cshape = (ctypes.c_uint * len(shape))(*shape)
+    _check(lib, lib.MXNDArrayCreate(cshape, len(shape), 1, 0, 0,
+                                    ctypes.byref(h)))
+    return h
+
+
+def _nd_set(lib, h, arr):
+    arr = np.ascontiguousarray(arr, dtype="<f4")
+    _check(lib, lib.MXNDArraySyncCopyFromCPU(
+        h, arr.ctypes.data_as(ctypes.c_void_p), arr.size))
+
+
+def _nd_get(lib, h):
+    ndim = ctypes.c_uint()
+    pdata = c_uint_p()
+    _check(lib, lib.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                                      ctypes.byref(pdata)))
+    shape = tuple(pdata[i] for i in range(ndim.value))
+    out = np.empty(shape, dtype="<f4")
+    n = int(np.prod(shape)) if shape else 1
+    _check(lib, lib.MXNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p), n))
+    return out
+
+
+def _atomic(lib, op, keys=(), vals=()):
+    """CreateAtomicSymbol via a creator handle found by name."""
+    n = ctypes.c_uint()
+    creators = ctypes.POINTER(Handle)()
+    _check(lib, lib.MXSymbolListAtomicSymbolCreators(ctypes.byref(n),
+                                                     ctypes.byref(creators)))
+    name = ctypes.c_char_p()
+    creator = None
+    for i in range(n.value):
+        c = Handle(creators[i])
+        _check(lib, lib.MXSymbolGetAtomicSymbolName(c, ctypes.byref(name)))
+        if name.value.decode() == op:
+            creator = c
+            break
+    assert creator is not None, "op %s not found" % op
+    out = Handle()
+    _check(lib, lib.MXSymbolCreateAtomicSymbol(
+        creator, len(keys), _strs(*keys), _strs(*vals), ctypes.byref(out)))
+    return out
+
+
+def _compose(lib, sym, name, **inputs):
+    keys = _strs(*inputs.keys())
+    args = (Handle * len(inputs))(*[v for v in inputs.values()])
+    _check(lib, lib.MXSymbolCompose(sym, name.encode(), len(inputs), keys,
+                                    args))
+    return sym
+
+
+def _variable(lib, name):
+    out = Handle()
+    _check(lib, lib.MXSymbolCreateVariable(name.encode(), ctypes.byref(out)))
+    return out
+
+
+def test_reflection(libmx):
+    lib = libmx
+    n = ctypes.c_uint()
+    creators = ctypes.POINTER(Handle)()
+    _check(lib, lib.MXSymbolListAtomicSymbolCreators(ctypes.byref(n),
+                                                     ctypes.byref(creators)))
+    assert n.value > 200  # the full operator registry is visible
+    # reflect FullyConnected (the cpp-package autogen path)
+    fc = None
+    name = ctypes.c_char_p()
+    for i in range(n.value):
+        _check(lib, lib.MXSymbolGetAtomicSymbolName(Handle(creators[i]),
+                                                    ctypes.byref(name)))
+        if name.value == b"FullyConnected":
+            fc = Handle(creators[i])
+    desc = ctypes.c_char_p()
+    num_args = ctypes.c_uint()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    types = ctypes.POINTER(ctypes.c_char_p)()
+    descs = ctypes.POINTER(ctypes.c_char_p)()
+    kv = ctypes.c_char_p()
+    _check(lib, lib.MXSymbolGetAtomicSymbolInfo(
+        fc, ctypes.byref(name), ctypes.byref(desc), ctypes.byref(num_args),
+        ctypes.byref(names), ctypes.byref(types), ctypes.byref(descs),
+        ctypes.byref(kv)))
+    got = [names[i].decode() for i in range(num_args.value)]
+    assert "data" in got and "weight" in got and "num_hidden" in got
+
+
+def test_imperative_invoke(libmx):
+    lib = libmx
+    a = _nd_create(lib, (2, 3))
+    b = _nd_create(lib, (2, 3))
+    _nd_set(lib, a, np.arange(6).reshape(2, 3))
+    _nd_set(lib, b, np.ones((2, 3)))
+    n = ctypes.c_uint()
+    creators = ctypes.POINTER(Handle)()
+    _check(lib, lib.MXSymbolListAtomicSymbolCreators(ctypes.byref(n),
+                                                     ctypes.byref(creators)))
+    name = ctypes.c_char_p()
+    plus = None
+    for i in range(n.value):
+        _check(lib, lib.MXSymbolGetAtomicSymbolName(Handle(creators[i]),
+                                                    ctypes.byref(name)))
+        if name.value == b"elemwise_add":
+            plus = Handle(creators[i])
+    inputs = (Handle * 2)(a, b)
+    num_out = ctypes.c_int(0)
+    outputs = ctypes.POINTER(Handle)()
+    _check(lib, lib.MXImperativeInvoke(
+        plus, 2, inputs, ctypes.byref(num_out), ctypes.byref(outputs),
+        0, None, None))
+    assert num_out.value == 1
+    out = _nd_get(lib, Handle(outputs[0]))
+    np.testing.assert_allclose(out, np.arange(6).reshape(2, 3) + 1)
+    for h in (a, b, Handle(outputs[0])):
+        _check(lib, lib.MXNDArrayFree(h))
+
+
+def test_train_mlp_via_c_api(libmx):
+    """bind -> forward -> backward -> kvstore push/pull (C updater) -> learn."""
+    lib = libmx
+    rng = np.random.RandomState(0)
+    n, nin, nhid, ncls = 200, 10, 32, 2
+    labels = rng.randint(0, ncls, n).astype(np.float32)
+    data = (rng.randn(n, nin) * 0.5 + labels[:, None] * 2.0).astype(np.float32)
+
+    # ---- symbol: data -> FC(32) -> relu -> FC(2) -> SoftmaxOutput
+    x = _variable(lib, "data")
+    fc1 = _compose(lib, _atomic(lib, "FullyConnected",
+                                ("num_hidden",), ("32",)), "fc1", data=x)
+    act = _compose(lib, _atomic(lib, "Activation",
+                                ("act_type",), ("relu",)), "relu1", data=fc1)
+    fc2 = _compose(lib, _atomic(lib, "FullyConnected",
+                                ("num_hidden",), (str(ncls),)), "fc2",
+                   data=act)
+    lab = _variable(lib, "softmax_label")
+    loss = _compose(lib, _atomic(lib, "SoftmaxOutput"), "softmax",
+                    data=fc2, label=lab)
+
+    # ---- arg introspection + shape inference
+    nargs = ctypes.c_uint()
+    argnames_c = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXSymbolListArguments(loss, ctypes.byref(nargs),
+                                          ctypes.byref(argnames_c)))
+    arg_names = [argnames_c[i].decode() for i in range(nargs.value)]
+    assert arg_names[0] == "data" and arg_names[-1] == "softmax_label"
+
+    batch = 20
+    ind_ptr = (ctypes.c_uint * 3)(0, 2, 3)
+    shape_data = (ctypes.c_uint * 3)(batch, nin, batch)
+    in_size = ctypes.c_uint()
+    in_ndim = c_uint_p()
+    in_data = ctypes.POINTER(c_uint_p)()
+    out_size = ctypes.c_uint()
+    out_ndim = c_uint_p()
+    out_data = ctypes.POINTER(c_uint_p)()
+    aux_size = ctypes.c_uint()
+    aux_ndim = c_uint_p()
+    aux_data = ctypes.POINTER(c_uint_p)()
+    complete = ctypes.c_int()
+    _check(lib, lib.MXSymbolInferShape(
+        loss, 2, _strs("data", "softmax_label"), ind_ptr, shape_data,
+        ctypes.byref(in_size), ctypes.byref(in_ndim), ctypes.byref(in_data),
+        ctypes.byref(out_size), ctypes.byref(out_ndim),
+        ctypes.byref(out_data),
+        ctypes.byref(aux_size), ctypes.byref(aux_ndim),
+        ctypes.byref(aux_data), ctypes.byref(complete)))
+    assert complete.value == 1
+    arg_shapes = [tuple(in_data[i][j] for j in range(in_ndim[i]))
+                  for i in range(in_size.value)]
+
+    # ---- allocate args + grads; Xavier-ish init in numpy through the C API
+    args_h, grads_h, reqs = [], [], []
+    params = {}
+    for name, shape in zip(arg_names, arg_shapes):
+        h = _nd_create(lib, shape)
+        args_h.append(h)
+        if name in ("data", "softmax_label"):
+            grads_h.append(None)
+            reqs.append(0)          # null
+        else:
+            g = _nd_create(lib, shape)
+            _nd_set(lib, g, np.zeros(shape))
+            grads_h.append(g)
+            reqs.append(1)          # write
+            w = rng.uniform(-0.2, 0.2, shape).astype(np.float32) \
+                if len(shape) > 1 else np.zeros(shape, np.float32)
+            params[name] = h
+            _nd_set(lib, h, w)
+
+    ex = Handle()
+    args_arr = (Handle * len(args_h))(*args_h)
+    grads_arr = (Handle * len(args_h))(
+        *[g if g is not None else None for g in grads_h])
+    reqs_arr = (ctypes.c_uint * len(reqs))(*reqs)
+    _check(lib, lib.MXExecutorBind(loss, 1, 0, len(args_h), args_arr,
+                                   grads_arr, reqs_arr, 0, None,
+                                   ctypes.byref(ex)))
+
+    # ---- kvstore local with an SGD updater written against the C API
+    kv = Handle()
+    _check(lib, lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    param_names = [nm for nm in arg_names if nm in params]
+    keys = (ctypes.c_int * len(param_names))(*range(len(param_names)))
+    vals = (Handle * len(param_names))(*[params[nm] for nm in param_names])
+    _check(lib, lib.MXKVStoreInit(kv, len(param_names), keys, vals))
+
+    UPDATER = ctypes.CFUNCTYPE(None, ctypes.c_int, Handle, Handle,
+                               ctypes.c_void_p)
+
+    lr = 0.05
+    update_count = [0]
+
+    def sgd_update(key, recv, local, _):
+        recv, local = Handle(recv), Handle(local)  # callback args arrive as ints
+        g = _nd_get(lib, recv)
+        w = _nd_get(lib, local)
+        _nd_set(lib, local, w - lr * g)
+        update_count[0] += 1
+
+    cb = UPDATER(sgd_update)
+    _check(lib, lib.MXKVStoreSetUpdater(kv, cb, None))
+
+    # ---- training loop: forward/backward + push/pull per batch
+    grads_per_key = [grads_h[arg_names.index(nm)] for nm in param_names]
+    data_h = args_h[arg_names.index("data")]
+    label_h = args_h[arg_names.index("softmax_label")]
+    outs_size = ctypes.c_uint()
+    outs_p = ctypes.POINTER(Handle)()
+    for epoch in range(30):
+        for s in range(0, n, batch):
+            _nd_set(lib, data_h, data[s:s + batch])
+            _nd_set(lib, label_h, labels[s:s + batch])
+            _check(lib, lib.MXExecutorForward(ex, 1))
+            _check(lib, lib.MXExecutorBackward(ex, 0, None))
+            gvals = (Handle * len(param_names))(*grads_per_key)
+            _check(lib, lib.MXKVStorePush(kv, len(param_names), keys, gvals,
+                                          0))
+            wvals = (Handle * len(param_names))(
+                *[params[nm] for nm in param_names])
+            _check(lib, lib.MXKVStorePull(kv, len(param_names), keys, wvals,
+                                          0))
+    assert update_count[0] == 30 * (n // batch) * len(param_names)
+
+    # ---- evaluate through the executor
+    correct = 0
+    for s in range(0, n, batch):
+        _nd_set(lib, data_h, data[s:s + batch])
+        _nd_set(lib, label_h, labels[s:s + batch])
+        _check(lib, lib.MXExecutorForward(ex, 0))
+        _check(lib, lib.MXExecutorOutputs(ex, ctypes.byref(outs_size),
+                                          ctypes.byref(outs_p)))
+        probs = _nd_get(lib, Handle(outs_p[0]))
+        correct += int((probs.argmax(1) == labels[s:s + batch]).sum())
+        for i in range(outs_size.value):
+            _check(lib, lib.MXNDArrayFree(Handle(outs_p[i])))
+    acc = correct / float(n)
+    assert acc > 0.95, "C-API-trained MLP accuracy %.3f" % acc
+
+    _check(lib, lib.MXKVStoreFree(kv))
+    _check(lib, lib.MXExecutorFree(ex))
+
+
+def test_data_iter_via_c_api(libmx, tmp_path):
+    """MXListDataIters + CSVIter drive (reference c_api.h:1079 family)."""
+    lib = libmx
+    csv = tmp_path / "data.csv"
+    arr = np.arange(20, dtype=np.float32).reshape(5, 4)
+    np.savetxt(csv, arr, delimiter=",", fmt="%g")
+    n = ctypes.c_uint()
+    creators = ctypes.POINTER(Handle)()
+    _check(lib, lib.MXListDataIters(ctypes.byref(n), ctypes.byref(creators)))
+    assert n.value >= 3
+    name = ctypes.c_char_p()
+    desc = ctypes.c_char_p()
+    csv_creator = None
+    for i in range(n.value):
+        _check(lib, lib.MXDataIterGetIterInfo(Handle(creators[i]), ctypes.byref(name),
+                                              ctypes.byref(desc)))
+        if name.value == b"CSVIter":
+            csv_creator = Handle(creators[i])
+    assert csv_creator is not None
+    it = Handle()
+    _check(lib, lib.MXDataIterCreateIter(
+        csv_creator, 3,
+        _strs("data_csv", "data_shape", "batch_size"),
+        _strs(str(csv), "(4,)", "5"), ctypes.byref(it)))
+    has = ctypes.c_int()
+    _check(lib, lib.MXDataIterNext(it, ctypes.byref(has)))
+    assert has.value == 1
+    d = Handle()
+    _check(lib, lib.MXDataIterGetData(it, ctypes.byref(d)))
+    got = _nd_get(lib, d)
+    np.testing.assert_allclose(got, arr)
+    _check(lib, lib.MXNDArrayFree(d))
+    _check(lib, lib.MXDataIterBeforeFirst(it))
+    _check(lib, lib.MXDataIterNext(it, ctypes.byref(has)))
+    assert has.value == 1
+    _check(lib, lib.MXDataIterFree(it))
+
+
+def test_executor_and_symbol_extras(libmx):
+    lib = libmx
+    x = _variable(lib, "data")
+    fc = _compose(lib, _atomic(lib, "FullyConnected",
+                               ("num_hidden",), ("4",)), "fc", data=x)
+    # attr get/set
+    _check(lib, lib.MXSymbolSetAttr(fc, b"color", b"red"))
+    out = ctypes.c_char_p()
+    ok = ctypes.c_int()
+    _check(lib, lib.MXSymbolGetAttr(fc, b"color", ctypes.byref(out),
+                                    ctypes.byref(ok)))
+    assert ok.value == 1 and out.value == b"red"
+    # copy + print + internals + output
+    cp = Handle()
+    _check(lib, lib.MXSymbolCopy(fc, ctypes.byref(cp)))
+    s = ctypes.c_char_p()
+    _check(lib, lib.MXSymbolPrint(cp, ctypes.byref(s)))
+    assert b"fc" in s.value
+    internals = Handle()
+    _check(lib, lib.MXSymbolGetInternals(fc, ctypes.byref(internals)))
+    nout = ctypes.c_uint()
+    outs = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXSymbolListOutputs(internals, ctypes.byref(nout),
+                                        ctypes.byref(outs)))
+    assert nout.value >= 3
+    one = Handle()
+    _check(lib, lib.MXSymbolGetOutput(internals, 0, ctypes.byref(one)))
+    for h in (cp, internals, one, fc, x):
+        _check(lib, lib.MXSymbolFree(h))
+
+
+def test_kvstore_type_rank(libmx):
+    lib = libmx
+    kv = Handle()
+    _check(lib, lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    t = ctypes.c_char_p()
+    _check(lib, lib.MXKVStoreGetType(kv, ctypes.byref(t)))
+    assert t.value == b"local"
+    r = ctypes.c_int()
+    _check(lib, lib.MXKVStoreGetRank(kv, ctypes.byref(r)))
+    assert r.value == 0
+    sz = ctypes.c_int()
+    _check(lib, lib.MXKVStoreGetGroupSize(kv, ctypes.byref(sz)))
+    assert sz.value == 1
+    _check(lib, lib.MXKVStoreBarrier(kv))
+    assert lib.MXKVStoreRunServer(kv) == 0
+    _check(lib, lib.MXKVStoreFree(kv))
