@@ -1,15 +1,23 @@
 /*
- * Header-only C++ frontend (parity: reference cpp-package/include/mxnet-cpp/
- * — NDArray/Symbol/Predictor value classes over the C API).
+ * Header-only C++ training frontend (parity: reference
+ * cpp-package/include/mxnet-cpp/ — NDArray/Symbol/Executor/Optimizer/KVStore
+ * value classes over the C API, cpp-package/include/mxnet-cpp/ndarray.h,
+ * symbol.h, executor.hpp, optimizer.hpp, kvstore.hpp).
  *
  * TPU-native: identical user surface, but binds to libmxnet_tpu.so whose
- * compute path is XLA.  RAII handles, exceptions on failure.
+ * compute path is XLA.  Shared-ownership handles (reference NDBlob/SymBlob
+ * pattern), exceptions on failure.  Operator constructors are generated from
+ * the registry via the reflection C API into op.h (see src/op_h_generator.cc).
  */
 #ifndef MXNET_CPP_MXNETCPP_H_
 #define MXNET_CPP_MXNETCPP_H_
 
+#include <fstream>
+#include <map>
+#include <memory>
 #include <stdexcept>
 #include <string>
+#include <utility>
 #include <vector>
 
 #include "mxnet_tpu/c_api.h"
@@ -35,35 +43,55 @@ class Context {
   int type_, id_;
 };
 
+/* shared-ownership blob (parity: reference NDBlob, ndarray.h:37);
+ * own=false wraps a handle whose lifetime belongs to someone else (the
+ * kvstore updater callback's arguments) */
+struct NDBlob {
+  explicit NDBlob(NDArrayHandle h, bool own = true) : handle(h), own(own) {}
+  ~NDBlob() {
+    if (handle != nullptr && own) MXNDArrayFree(handle);
+  }
+  NDBlob(const NDBlob &) = delete;
+  NDBlob &operator=(const NDBlob &) = delete;
+  NDArrayHandle handle;
+  bool own;
+};
+
 class NDArray {
  public:
+  NDArray() = default;
   NDArray(const std::vector<mx_uint> &shape, const Context &ctx) {
-    Check(MXNDArrayCreate(shape.data(),
-                          static_cast<mx_uint>(shape.size()),
-                          ctx.dev_type(), ctx.dev_id(), 0, &handle_));
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayCreate(shape.data(), static_cast<mx_uint>(shape.size()),
+                          ctx.dev_type(), ctx.dev_id(), 0, &h));
+    blob_ = std::make_shared<NDBlob>(h);
   }
-  explicit NDArray(NDArrayHandle handle) : handle_(handle) {}
-  NDArray(const NDArray &) = delete;
-  NDArray &operator=(const NDArray &) = delete;
-  NDArray(NDArray &&other) noexcept : handle_(other.handle_) {
-    other.handle_ = nullptr;
-  }
-  ~NDArray() {
-    if (handle_ != nullptr) MXNDArrayFree(handle_);
+  explicit NDArray(NDArrayHandle handle)
+      : blob_(std::make_shared<NDBlob>(handle)) {}
+  /* non-owning view over a handle someone else will free */
+  static NDArray Borrow(NDArrayHandle handle) {
+    NDArray a;
+    a.blob_ = std::make_shared<NDBlob>(handle, false);
+    return a;
   }
 
+  bool IsNull() const { return blob_ == nullptr; }
+
+  void SyncCopyFromCPU(const mx_float *data, size_t count) {
+    Check(MXNDArraySyncCopyFromCPU(handle(), data, count));
+  }
   void SyncCopyFromCPU(const std::vector<mx_float> &data) {
-    Check(MXNDArraySyncCopyFromCPU(handle_, data.data(), data.size()));
+    SyncCopyFromCPU(data.data(), data.size());
   }
   std::vector<mx_float> SyncCopyToCPU() const {
     std::vector<mx_float> out(Size());
-    Check(MXNDArraySyncCopyToCPU(handle_, out.data(), out.size()));
+    Check(MXNDArraySyncCopyToCPU(handle(), out.data(), out.size()));
     return out;
   }
   std::vector<mx_uint> Shape() const {
     mx_uint ndim = 0;
     const mx_uint *data = nullptr;
-    Check(MXNDArrayGetShape(handle_, &ndim, &data));
+    Check(MXNDArrayGetShape(handle(), &ndim, &data));
     return std::vector<mx_uint>(data, data + ndim);
   }
   size_t Size() const {
@@ -71,14 +99,76 @@ class NDArray {
     for (mx_uint d : Shape()) n *= d;
     return n;
   }
-  NDArrayHandle handle() const { return handle_; }
+  int GetDType() const {
+    int dt = 0;
+    Check(MXNDArrayGetDType(handle(), &dt));
+    return dt;
+  }
+  NDArray Slice(mx_uint begin, mx_uint end) const {
+    NDArrayHandle out = nullptr;
+    Check(MXNDArraySlice(handle(), begin, end, &out));
+    return NDArray(out);
+  }
+  NDArray Reshape(const std::vector<int> &dims) const {
+    NDArrayHandle out = nullptr;
+    Check(MXNDArrayReshape(handle(), static_cast<int>(dims.size()),
+                           dims.data(), &out));
+    return NDArray(out);
+  }
+  static void WaitAll() { Check(MXNDArrayWaitAll()); }
+  static void Save(const std::string &fname,
+                   const std::map<std::string, NDArray> &params) {
+    std::vector<NDArrayHandle> handles;
+    std::vector<const char *> keys;
+    for (auto &kv : params) {
+      keys.push_back(kv.first.c_str());
+      handles.push_back(kv.second.handle());
+    }
+    Check(MXNDArraySave(fname.c_str(),
+                        static_cast<mx_uint>(handles.size()),
+                        handles.data(), keys.data()));
+  }
+  static std::map<std::string, NDArray> Load(const std::string &fname) {
+    mx_uint n = 0, nn = 0;
+    NDArrayHandle *arrs = nullptr;
+    const char **names = nullptr;
+    Check(MXNDArrayLoad(fname.c_str(), &n, &arrs, &nn, &names));
+    std::map<std::string, NDArray> out;
+    for (mx_uint i = 0; i < n; ++i) {
+      std::string key = (i < nn) ? names[i] : ("arr_" + std::to_string(i));
+      out.emplace(key, NDArray(arrs[i]));
+    }
+    return out;
+  }
+  NDArrayHandle handle() const {
+    return blob_ != nullptr ? blob_->handle : nullptr;
+  }
 
  private:
-  NDArrayHandle handle_ = nullptr;
+  std::shared_ptr<NDBlob> blob_;
+};
+
+/* ------------------------------------------------------------------ Symbol */
+struct SymBlob {
+  explicit SymBlob(SymbolHandle h) : handle(h) {}
+  ~SymBlob() {
+    if (handle != nullptr) MXSymbolFree(handle);
+  }
+  SymBlob(const SymBlob &) = delete;
+  SymBlob &operator=(const SymBlob &) = delete;
+  SymbolHandle handle;
 };
 
 class Symbol {
  public:
+  Symbol() = default;
+  explicit Symbol(SymbolHandle h) : blob_(std::make_shared<SymBlob>(h)) {}
+
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
   static Symbol FromJSON(const std::string &json) {
     SymbolHandle h = nullptr;
     Check(MXSymbolCreateFromJSON(json.c_str(), &h));
@@ -89,41 +179,343 @@ class Symbol {
     Check(MXSymbolCreateFromFile(fname.c_str(), &h));
     return Symbol(h);
   }
-  explicit Symbol(SymbolHandle h) : handle_(h) {}
-  Symbol(const Symbol &) = delete;
-  Symbol &operator=(const Symbol &) = delete;
-  Symbol(Symbol &&other) noexcept : handle_(other.handle_) {
-    other.handle_ = nullptr;
+  static Symbol Group(const std::vector<Symbol> &parts) {
+    std::vector<SymbolHandle> hs;
+    for (auto &s : parts) hs.push_back(s.handle());
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateGroup(static_cast<mx_uint>(hs.size()), hs.data(),
+                              &h));
+    return Symbol(h);
   }
-  ~Symbol() {
-    if (handle_ != nullptr) MXSymbolFree(handle_);
+
+  /* generic operator constructor (the generated op.h calls this; parity:
+   * reference Operator::CreateSymbol, op.h autogen) */
+  static Symbol CreateOperator(
+      const std::string &op, const std::string &name,
+      const std::vector<std::pair<std::string, Symbol>> &inputs,
+      const std::map<std::string, std::string> &attrs = {}) {
+    static std::map<std::string, AtomicSymbolCreator> registry;
+    if (registry.empty()) {
+      mx_uint n = 0;
+      AtomicSymbolCreator *creators = nullptr;
+      Check(MXSymbolListAtomicSymbolCreators(&n, &creators));
+      for (mx_uint i = 0; i < n; ++i) {
+        const char *nm = nullptr;
+        Check(MXSymbolGetAtomicSymbolName(creators[i], &nm));
+        registry[nm] = creators[i];
+      }
+    }
+    auto it = registry.find(op);
+    if (it == registry.end()) {
+      throw std::runtime_error("unknown operator " + op);
+    }
+    std::vector<const char *> akeys, avals;
+    for (auto &kv : attrs) {
+      akeys.push_back(kv.first.c_str());
+      avals.push_back(kv.second.c_str());
+    }
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateAtomicSymbol(it->second,
+                                     static_cast<mx_uint>(akeys.size()),
+                                     akeys.data(), avals.data(), &h));
+    Symbol sym(h);
+    std::vector<const char *> ikeys;
+    std::vector<SymbolHandle> ivals;
+    for (auto &kv : inputs) {
+      ikeys.push_back(kv.first.c_str());
+      ivals.push_back(kv.second.handle());
+    }
+    Check(MXSymbolCompose(h, name.c_str(),
+                          static_cast<mx_uint>(ivals.size()), ikeys.data(),
+                          ivals.data()));
+    return sym;
   }
+
+  Symbol operator+(const Symbol &rhs) const { return Binary("_plus", rhs); }
+  Symbol operator-(const Symbol &rhs) const { return Binary("_minus", rhs); }
+  Symbol operator*(const Symbol &rhs) const { return Binary("_mul", rhs); }
+  Symbol operator/(const Symbol &rhs) const { return Binary("_div", rhs); }
 
   std::string ToJSON() const {
     const char *json = nullptr;
-    Check(MXSymbolSaveToJSON(handle_, &json));
+    Check(MXSymbolSaveToJSON(handle(), &json));
     return json;
   }
+  void Save(const std::string &fname) const;
   std::vector<std::string> ListArguments() const {
     return StrList(&MXSymbolListArguments);
   }
   std::vector<std::string> ListOutputs() const {
     return StrList(&MXSymbolListOutputs);
   }
-  SymbolHandle handle() const { return handle_; }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return StrList(&MXSymbolListAuxiliaryStates);
+  }
+
+  /* shape inference (parity: symbol.h InferShape/InferArgsMap) */
+  bool InferShape(
+      const std::map<std::string, std::vector<mx_uint>> &known,
+      std::vector<std::vector<mx_uint>> *arg_shapes,
+      std::vector<std::vector<mx_uint>> *out_shapes,
+      std::vector<std::vector<mx_uint>> *aux_shapes) const {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> ind_ptr{0};
+    std::vector<mx_uint> data;
+    for (auto &kv : known) {
+      keys.push_back(kv.first.c_str());
+      for (mx_uint d : kv.second) data.push_back(d);
+      ind_ptr.push_back(static_cast<mx_uint>(data.size()));
+    }
+    mx_uint in_n = 0, out_n = 0, aux_n = 0;
+    const mx_uint *in_nd = nullptr, *out_nd = nullptr, *aux_nd = nullptr;
+    const mx_uint **in_d = nullptr, **out_d = nullptr, **aux_d = nullptr;
+    int complete = 0;
+    Check(MXSymbolInferShape(handle(),
+                             static_cast<mx_uint>(keys.size()), keys.data(),
+                             ind_ptr.data(), data.data(), &in_n, &in_nd,
+                             &in_d, &out_n, &out_nd, &out_d, &aux_n, &aux_nd,
+                             &aux_d, &complete));
+    if (!complete) return false;
+    auto fill = [](mx_uint n, const mx_uint *nd, const mx_uint **d,
+                   std::vector<std::vector<mx_uint>> *out) {
+      out->clear();
+      for (mx_uint i = 0; i < n; ++i) {
+        out->emplace_back(d[i], d[i] + nd[i]);
+      }
+    };
+    if (arg_shapes != nullptr) fill(in_n, in_nd, in_d, arg_shapes);
+    if (out_shapes != nullptr) fill(out_n, out_nd, out_d, out_shapes);
+    if (aux_shapes != nullptr) fill(aux_n, aux_nd, aux_d, aux_shapes);
+    return true;
+  }
+  SymbolHandle handle() const {
+    return blob_ != nullptr ? blob_->handle : nullptr;
+  }
 
  private:
+  Symbol Binary(const std::string &op, const Symbol &rhs) const {
+    return CreateOperator(op, "", {{"lhs", *this}, {"rhs", rhs}});
+  }
   template <typename F>
   std::vector<std::string> StrList(F fn) const {
     mx_uint n = 0;
     const char **arr = nullptr;
-    Check(fn(handle_, &n, &arr));
+    Check(fn(handle(), &n, &arr));
     std::vector<std::string> out;
     for (mx_uint i = 0; i < n; ++i) out.emplace_back(arr[i]);
     return out;
   }
-  SymbolHandle handle_ = nullptr;
+  std::shared_ptr<SymBlob> blob_;
 };
+
+/* ---------------------------------------------------------------- Executor */
+class Executor {
+ public:
+  /* parity: reference executor.hpp — holds the bound arrays so callers can
+   * stage inputs / read gradients by argument name */
+  Executor(const Symbol &symbol, const Context &ctx,
+           const std::vector<NDArray> &arg_arrays,
+           const std::vector<NDArray> &grad_arrays,
+           const std::vector<mx_uint> &grad_reqs,
+           const std::vector<NDArray> &aux_arrays = {})
+      : arg_arrays(arg_arrays), grad_arrays(grad_arrays),
+        aux_arrays(aux_arrays) {
+    if (grad_arrays.size() != arg_arrays.size() ||
+        grad_reqs.size() != arg_arrays.size()) {
+      throw std::runtime_error(
+          "Executor: grad_arrays and grad_reqs must match arg_arrays "
+          "one-to-one (use null NDArrays + req 0 for no-grad inputs)");
+    }
+    std::vector<NDArrayHandle> args, grads, auxs;
+    for (auto &a : arg_arrays) args.push_back(a.handle());
+    for (auto &g : grad_arrays) grads.push_back(g.handle());
+    for (auto &a : aux_arrays) auxs.push_back(a.handle());
+    std::vector<mx_uint> reqs = grad_reqs;
+    Check(MXExecutorBind(symbol.handle(), ctx.dev_type(), ctx.dev_id(),
+                         static_cast<mx_uint>(args.size()), args.data(),
+                         grads.data(), reqs.data(),
+                         static_cast<mx_uint>(auxs.size()),
+                         auxs.empty() ? nullptr : auxs.data(), &handle_));
+  }
+  Executor(const Executor &) = delete;
+  Executor &operator=(const Executor &) = delete;
+  ~Executor() {
+    if (handle_ != nullptr) MXExecutorFree(handle_);
+  }
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(handle_, is_train ? 1 : 0));
+  }
+  void Backward(const std::vector<NDArray> &head_grads = {}) {
+    std::vector<NDArrayHandle> hs;
+    for (auto &h : head_grads) hs.push_back(h.handle());
+    Check(MXExecutorBackward(handle_,
+                             static_cast<mx_uint>(hs.size()),
+                             hs.empty() ? nullptr : hs.data()));
+  }
+  std::vector<NDArray> Outputs() const {
+    mx_uint n = 0;
+    NDArrayHandle *arr = nullptr;
+    Check(MXExecutorOutputs(handle_, &n, &arr));
+    std::vector<NDArray> out;
+    for (mx_uint i = 0; i < n; ++i) out.emplace_back(arr[i]);
+    return out;
+  }
+
+  std::vector<NDArray> arg_arrays;
+  std::vector<NDArray> grad_arrays;
+  std::vector<NDArray> aux_arrays;
+
+ private:
+  ExecutorHandle handle_ = nullptr;
+};
+
+/* --------------------------------------------------------------- Optimizer */
+/* parity: reference optimizer.hpp — Update(index, weight, grad) applies one
+ * step.  The math runs on-device through MXImperativeInvoke of the fused
+ * optimizer ops (reference src/operator/optimizer_op.cc). */
+class Optimizer {
+ public:
+  explicit Optimizer(float lr) : lr_(lr) {}
+  virtual ~Optimizer() = default;
+  virtual void Update(int index, NDArray weight, NDArray grad) = 0;
+
+ protected:
+  static AtomicSymbolCreator FindOp(const std::string &name) {
+    mx_uint n = 0;
+    AtomicSymbolCreator *creators = nullptr;
+    Check(MXSymbolListAtomicSymbolCreators(&n, &creators));
+    for (mx_uint i = 0; i < n; ++i) {
+      const char *nm = nullptr;
+      Check(MXSymbolGetAtomicSymbolName(creators[i], &nm));
+      if (name == nm) return creators[i];
+    }
+    throw std::runtime_error("optimizer op not found: " + name);
+  }
+  float lr_;
+};
+
+class SGDOptimizer : public Optimizer {
+ public:
+  explicit SGDOptimizer(float lr, float momentum = 0.0f, float wd = 0.0f,
+                        float rescale_grad = 1.0f)
+      : Optimizer(lr), momentum_(momentum), wd_(wd), rescale_(rescale_grad) {}
+
+  void Update(int index, NDArray weight, NDArray grad) override {
+    static AtomicSymbolCreator sgd = FindOp("sgd_update");
+    static AtomicSymbolCreator sgd_mom = FindOp("sgd_mom_update");
+    std::string lr = std::to_string(lr_), wd = std::to_string(wd_);
+    std::string mom = std::to_string(momentum_);
+    std::string rs = std::to_string(rescale_);
+    NDArrayHandle out = weight.handle();
+    NDArrayHandle *outs = &out;
+    int num_out = 1;
+    if (momentum_ != 0.0f) {
+      if (mom_.find(index) == mom_.end()) {
+        /* momentum lives on the weight's device */
+        int dev_type = 1, dev_id = 0;
+        Check(MXNDArrayGetContext(weight.handle(), &dev_type, &dev_id));
+        NDArray m(weight.Shape(), Context(dev_type, dev_id));
+        std::vector<mx_float> zeros(m.Size(), 0.0f);
+        m.SyncCopyFromCPU(zeros);
+        mom_.emplace(index, m);
+      }
+      /* fused op returns (weight, mom); write both in place */
+      NDArrayHandle io[2] = {weight.handle(), mom_.at(index).handle()};
+      NDArrayHandle ins[3] = {weight.handle(), grad.handle(),
+                              mom_.at(index).handle()};
+      NDArrayHandle *outs2 = io;
+      int n2 = 2;
+      const char *keys[4] = {"lr", "wd", "momentum", "rescale_grad"};
+      const char *vals[4] = {lr.c_str(), wd.c_str(), mom.c_str(), rs.c_str()};
+      Check(MXImperativeInvoke(sgd_mom, 3, ins, &n2, &outs2, 4, keys, vals));
+      return;
+    }
+    NDArrayHandle ins[2] = {weight.handle(), grad.handle()};
+    const char *keys[3] = {"lr", "wd", "rescale_grad"};
+    const char *vals[3] = {lr.c_str(), wd.c_str(), rs.c_str()};
+    Check(MXImperativeInvoke(sgd, 2, ins, &num_out, &outs, 3, keys, vals));
+  }
+
+ private:
+  float momentum_, wd_, rescale_;
+  std::map<int, NDArray> mom_;
+};
+
+/* ----------------------------------------------------------------- KVStore */
+class KVStore {
+ public:
+  explicit KVStore(const std::string &type = "local") {
+    Check(MXKVStoreCreate(type.c_str(), &handle_));
+  }
+  KVStore(const KVStore &) = delete;
+  KVStore &operator=(const KVStore &) = delete;
+  ~KVStore() {
+    if (handle_ != nullptr) MXKVStoreFree(handle_);
+  }
+
+  void Init(const std::vector<int> &keys, const std::vector<NDArray> &vals) {
+    auto hs = Handles(vals);
+    Check(MXKVStoreInit(handle_, static_cast<mx_uint>(keys.size()),
+                        keys.data(), hs.data()));
+  }
+  void Push(const std::vector<int> &keys, const std::vector<NDArray> &vals,
+            int priority = 0) {
+    auto hs = Handles(vals);
+    Check(MXKVStorePush(handle_, static_cast<mx_uint>(keys.size()),
+                        keys.data(), hs.data(), priority));
+  }
+  void Pull(const std::vector<int> &keys, std::vector<NDArray> *vals,
+            int priority = 0) {
+    auto hs = Handles(*vals);
+    Check(MXKVStorePull(handle_, static_cast<mx_uint>(keys.size()),
+                        keys.data(), hs.data(), priority));
+  }
+  /* install an Optimizer as the update rule applied at push time (parity:
+   * kvstore.hpp SetOptimizer; the reference ships the optimizer to server
+   * processes — on TPU the "server" is in-process) */
+  void SetOptimizer(Optimizer *opt) {
+    opt_ = opt;
+    Check(MXKVStoreSetUpdater(handle_, &KVStore::Updater, this));
+  }
+  int GetRank() const {
+    int r = 0;
+    Check(MXKVStoreGetRank(handle_, &r));
+    return r;
+  }
+  int GetNumWorkers() const {
+    int n = 0;
+    Check(MXKVStoreGetGroupSize(handle_, &n));
+    return n;
+  }
+  std::string GetType() const {
+    const char *t = nullptr;
+    Check(MXKVStoreGetType(handle_, &t));
+    return t;
+  }
+  void Barrier() { Check(MXKVStoreBarrier(handle_)); }
+
+ private:
+  static void Updater(int key, NDArrayHandle recv, NDArrayHandle local,
+                      void *self) {
+    auto *kv = static_cast<KVStore *>(self);
+    /* non-owning views: the caller owns these handles */
+    kv->opt_->Update(key, NDArray::Borrow(local), NDArray::Borrow(recv));
+  }
+  static std::vector<NDArrayHandle> Handles(const std::vector<NDArray> &v) {
+    std::vector<NDArrayHandle> hs;
+    for (auto &a : v) hs.push_back(a.handle());
+    return hs;
+  }
+  KVStoreHandle handle_ = nullptr;
+  Optimizer *opt_ = nullptr;
+};
+
+inline void Symbol::Save(const std::string &fname) const {
+  std::ofstream f(fname);
+  if (!f) throw std::runtime_error("cannot open " + fname);
+  f << ToJSON();
+}
 
 /* Forward-only inference (parity: cpp predict usage of MXPred*). */
 class Predictor {

@@ -205,10 +205,15 @@ def atomic_symbol_info(op_name):
     cpp-package op.h autogeneration."""
     op = _op_registry().get_op(str(op_name))
     params = op.normalize_attrs({})
+    try:
+        input_names = op.arg_names_for(params)
+    except Exception:
+        # ops whose inputs depend on mandatory attrs (Custom needs op_type)
+        input_names = []
     arg_names = []
     arg_types = []
     arg_descs = []
-    for n in op.arg_names_for(params):
+    for n in input_names:
         arg_names.append(n)
         arg_types.append("NDArray-or-Symbol")
         arg_descs.append("input: %s" % n)
@@ -347,8 +352,7 @@ def executor_bind(handle, dev_type, dev_id, arg_handles, grad_handles,
     symbol = _sym(handle)
     ctx = _ctx(dev_type, dev_id)
     args = list(arg_handles)
-    grads = [g if g is not None else None for g in grad_handles] \
-        if grad_handles else None
+    grads = list(grad_handles) if grad_handles else None
     reqs = [_GRAD_REQ.get(int(c), "null") for c in grad_req_codes]
     aux = list(aux_handles) if aux_handles else None
     return symbol.bind(ctx, args=args, args_grad=grads, grad_req=reqs,

