@@ -114,8 +114,7 @@ int main() {
       exec.Forward(true);
       exec.Backward();
       kv.Push(param_keys, param_grads);
-      std::vector<NDArray> pulled = param_arrays;
-      kv.Pull(param_keys, &pulled);
+      kv.Pull(param_keys, &param_arrays);
     }
   }
 

@@ -302,6 +302,7 @@ class Module(BaseModule):
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
+            self._loaded_opt_states = True
 
     def borrow_optimizer(self, shared_module):
         """(parity: Module.borrow_optimizer — bucketing modules share one
@@ -361,6 +362,13 @@ class Module(BaseModule):
         self._exec_group.update_metric(eval_metric, labels)
 
     def _sync_params_from_devices(self):
+        ff = getattr(self, "_active_fused", None)
+        if ff is not None:
+            # mid-fused-fit: the live parameters are the fused pytrees, not
+            # the executor arrays (mid-epoch get_params / checkpoint
+            # callbacks must see current weights)
+            ff.sync_back()
+            return
         self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
@@ -375,6 +383,9 @@ class Module(BaseModule):
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
+        # the fused fit path seeds fresh optimizer state; explicitly loaded
+        # states must route training through the general path
+        self._loaded_opt_states = True
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
@@ -384,3 +395,192 @@ class Module(BaseModule):
     def install_monitor(self, mon):
         assert self.binded
         self._exec_group.install_monitor(mon)
+
+    # ------------------------------------------------- fused fit fast path
+    def _start_fused_fit(self):
+        """Return a TrainStep-backed per-batch trainer, or None.
+
+        The reference's ``Module.fit`` IS its benchmarked path
+        (base_module.py:369-518); here the executor + host-side optimizer
+        loop leaves the TPU idle between kernels, so when the common case
+        holds — one context, grad_req='write', a fused-optimizer-supported
+        update rule, no monitor/states/fixed params — fit's inner loop runs
+        on the fused SPMD TrainStep instead: forward + backward + optimizer
+        update as ONE donated XLA program per batch (mxnet_tpu/train.py).
+        Disable with MXNET_FUSED_FIT=0."""
+        from ..base import get_env
+        if get_env("MXNET_FUSED_FIT", "1") == "0":
+            return None
+        if (len(self._context) != 1 or self._state_names or
+                self._fixed_param_names or self.inputs_need_grad or
+                self._preload_opt_states is not None or
+                getattr(self, "_loaded_opt_states", False)):
+            return None
+        if self._exec_group is None or \
+                self._exec_group._default_grad_req != "write":
+            return None
+        # a dist kvstore aggregates gradients across processes — the fused
+        # single-process step must not bypass it
+        if self._kvstore is not None and \
+                "dist" in getattr(self._kvstore, "type", ""):
+            return None
+        try:
+            return _FusedFit(self)
+        except MXNetError:
+            return None  # unsupported optimizer etc. — general path
+
+
+class _FusedFit(object):
+    """Per-batch fused training engine behind Module.fit (see above)."""
+
+    def __init__(self, module):
+        import jax
+        from ..train import TrainStep
+        self._mod = module
+        # one XLA program per (optimizer config): cache the compiled
+        # TrainStep on the module — each fit() re-creates the optimizer, and
+        # rebuilding the step would recompile every call
+        opt = module._optimizer
+        key = (type(opt).__name__,
+               tuple(sorted((k, v) for k, v in vars(opt).items()
+                            if isinstance(v, (int, float, bool, str)))),
+               tuple(sorted(getattr(opt, "lr_mult", {}).items())),
+               tuple(sorted(getattr(opt, "wd_mult", {}).items())))
+        cached = getattr(module, "_fused_ts_cache", None)
+        if cached is not None and cached[0] == key:
+            self._ts = cached[1]
+            self._ts.optimizer = opt
+            self._ts.fopt.opt = opt
+            self._ts.num_update = 0
+        else:
+            self._ts = TrainStep(module._symbol, opt,
+                                 data_names=tuple(module._data_names),
+                                 label_names=tuple(module._label_names))
+            module._fused_ts_cache = (key, self._ts)
+        dev = module._context[0].jax_device()
+        self._dev = dev
+        arg_params, aux_params = module.get_params()
+        self._params = {n: jax.device_put(arg_params[n].asnumpy(), dev)
+                        for n in self._ts.param_names}
+        state = self._ts.fopt.init_state(
+            {n: arg_params[n].asnumpy() for n in self._ts.param_names})
+        self._state = {n: tuple(jax.device_put(s, dev) for s in st)
+                       for n, st in state.items()}
+        self._import_updater_state()
+        self._aux = {n: jax.device_put(aux_params[n].asnumpy(), dev)
+                     for n in self._ts.aux_names}
+        names = module._data_names + module._label_names
+        self._input_names = names
+
+    def _updater(self):
+        mod = self._mod
+        u = mod._updater
+        if u is None and mod._kvstore is not None:
+            u = getattr(mod._kvstore, "_updater", None)
+        return u
+
+    def _import_updater_state(self):
+        """Seed the fused optimizer state from the Updater's accumulated
+        states (a second fit() on the same module must continue momentum /
+        Adam moments exactly like the reference's persistent updater does;
+        sync_back exports in the same layout)."""
+        import jax
+        updater = self._updater()
+        if updater is None or not updater.states:
+            return
+        kind = self._ts.fopt.kind
+        for idx, name in enumerate(self._ts.param_names):
+            st = updater.states.get(idx)
+            if st is None:
+                continue
+            vals = st if isinstance(st, tuple) else (st,)
+            vals = tuple(v for v in vals if v is not None)
+            if len(vals) != len(self._state[name]):
+                continue  # layout mismatch (e.g. dcasgd's (mom, prev_w))
+            self._state[name] = tuple(
+                jax.device_put(v.asnumpy(), self._dev) for v in vals)
+        # continue the update count (Adam bias correction, lr schedules)
+        counts = getattr(self._mod._optimizer, "_index_update_count", None)
+        if counts:
+            self._ts.num_update = max(counts.values())
+
+    def step(self, data_batch):
+        """One fused step; returns (outputs, device_labels) as NDArrays.
+
+        Labels are staged to the compute device once and handed back so the
+        metric can reduce on device (one scalar transfer per batch instead
+        of full-tensor round trips — the dominant cost on a tunneled TPU)."""
+        import jax
+        import numpy as _np
+        arrays = list(data_batch.data) + list(data_batch.label or [])
+        # hand pjit HOST buffers: a CPU-committed jax array would be copied
+        # cross-device synchronously at dispatch; numpy stages async
+        batch = {n: (_np.asarray(a.value) if a.context.device_type == "cpu"
+                     else a.value)
+                 for n, a in zip(self._input_names, arrays)}
+        self._params, self._state, self._aux, outs = self._ts(
+            self._params, self._state, self._aux, batch)
+        # current weights now live in the fused pytrees, not the executors —
+        # route mid-epoch get_params through us (see _sync_params_from_devices)
+        self._mod._params_dirty = True
+        self._mod._active_fused = self
+        # labels staged onto the step's device so the metric's same-device
+        # lazy reduction engages
+        labels = [nd.NDArray(jax.device_put(batch[n], self._dev))
+                  for n in self._mod._label_names if n in batch]
+        return [nd.NDArray(o) for o in outs], labels
+
+    def sync_back(self):
+        """Write the fused parameters back into the module (so get_params,
+        checkpoints, score and later non-fused use see the trained state),
+        and export the fused optimizer state into the Updater so
+        save_optimizer_states reflects the training that actually happened."""
+        import jax
+        mod = self._mod
+        arg = {n: nd.NDArray(v) for n, v in self._params.items()}
+        aux = {n: nd.NDArray(v) for n, v in self._aux.items()}
+        mod._exec_group.set_params(arg, aux)
+        if mod._arg_params is not None:
+            # ONE device->host transfer: concatenate on device, split on host
+            # (jax.device_get fetches leaf by leaf — a round trip each on a
+            # tunneled TPU)
+            import jax.numpy as jnp
+            import numpy as _np
+            items = [("arg", n, v) for n, v in sorted(self._params.items())] \
+                + [("aux", n, v) for n, v in sorted(self._aux.items())]
+            flat = _np.asarray(jnp.concatenate(
+                [v.reshape(-1).astype(jnp.float32) for _, _, v in items]))
+            ofs = 0
+            for kind, n, v in items:
+                size = 1
+                for d in v.shape:
+                    size *= d
+                chunk = flat[ofs:ofs + size].reshape(v.shape)
+                ofs += size
+                dst = mod._arg_params if kind == "arg" else mod._aux_params
+                dst[n][:] = chunk
+        mod._params_dirty = False
+        mod._active_fused = None
+        # an explicit kvstore holds its own stored weights (pull sources) —
+        # refresh them or a later general-path update() would revert training
+        if mod._kvstore is not None:
+            store = getattr(mod._kvstore, "_store", None)
+            if store:
+                for idx, name in enumerate(self._ts.param_names):
+                    if idx in store:
+                        store[idx]._set_value(self._params[name])
+        updater = self._updater()
+        if updater is None:
+            return
+        kind = self._ts.fopt.kind
+        for idx, name in enumerate(self._ts.param_names):
+            st = tuple(nd.NDArray(s) for s in self._state[name])
+            # mirror each Optimizer.create_state layout (optimizer.py)
+            if kind in ("sgd", "ccsgd", "nag"):
+                updater.states[idx] = st[0] if st else None
+            elif kind in ("adam", "adadelta"):
+                updater.states[idx] = (st[0], st[1])
+            elif kind == "rmsprop":
+                updater.states[idx] = (st[0],)
+            elif kind == "adagrad":
+                updater.states[idx] = st[0]

@@ -71,6 +71,9 @@ class _FunctionalOptimizer(object):
             raise MXNetError(
                 "TrainStep supports sgd/nag/adam/rmsprop/adagrad/adadelta; "
                 "got %s (use the Module path for others)" % self.kind)
+        if self.kind == "rmsprop" and getattr(optimizer, "centered", False):
+            raise MXNetError("TrainStep implements the Tieleman (non-"
+                             "centered) RMSProp only; use the Module path")
 
     # ------------------------------------------------------------------ state
     def init_state(self, params):

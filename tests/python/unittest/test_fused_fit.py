@@ -1,0 +1,87 @@
+"""Module.fit fused fast path (VERDICT r2 #5): fit's inner loop lowers onto
+the fused TrainStep when the common case holds.  These tests pin that the
+fast path (a) produces the same trained parameters as the general
+executor+updater path, (b) exports optimizer state so save/load_optimizer_
+states still round-trips, and (c) stays OFF when its preconditions fail."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+from mxnet_tpu import random as mxr
+
+
+def _fit(fused, optimizer="sgd", opt_params=None, num_epoch=3, ctxs=None,
+         fixed=None):
+    os.environ["MXNET_FUSED_FIT"] = "1" if fused else "0"
+    try:
+        np.random.seed(0)
+        x = np.random.randn(120, 1, 12, 12).astype(np.float32)
+        y = np.random.randint(0, 4, 120).astype(np.float32)
+        it = mx.io.NDArrayIter(x, y, batch_size=30)
+        net = models.get_mlp(num_classes=4) if hasattr(models, "get_mlp") \
+            else models.get_lenet(num_classes=4)
+        mod = mx.Module(net, context=ctxs, fixed_param_names=fixed)
+        mxr.seed(7)
+        mod.fit(it, num_epoch=num_epoch, optimizer=optimizer,
+                optimizer_params=opt_params or {"learning_rate": 0.01},
+                initializer=mx.initializer.Xavier(magnitude=2.0))
+        return mod
+    finally:
+        os.environ.pop("MXNET_FUSED_FIT", None)
+
+
+@pytest.mark.parametrize("optimizer", ["sgd", "adam"])
+def test_fused_fit_matches_general_path(optimizer):
+    m1 = _fit(True, optimizer)
+    m0 = _fit(False, optimizer)
+    a1, _ = m1.get_params()
+    a0, _ = m0.get_params()
+    for k in a1:
+        p1, p0 = a1[k].asnumpy(), a0[k].asnumpy()
+        np.testing.assert_allclose(p1, p0, rtol=5e-3, atol=1e-5,
+                                   err_msg=k)
+
+
+def test_fused_fit_engages_and_converges():
+    np.random.seed(0)
+    n = 200
+    y = np.random.randint(0, 2, n).astype(np.float32)
+    x = (np.random.randn(n, 1, 28, 28) * 0.4
+         + y[:, None, None, None]).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=40, shuffle=True)
+    mod = mx.Module(models.get_lenet(num_classes=2))
+    mod.fit(it, num_epoch=8, optimizer_params={"learning_rate": 0.05},
+            initializer=mx.initializer.Xavier(magnitude=2.0))
+    # the fast path must actually have engaged (and been cached)
+    assert getattr(mod, "_fused_ts_cache", None) is not None
+    score = mod.score(mx.io.NDArrayIter(x, y, batch_size=40),
+                      mx.metric.Accuracy())
+    assert score[0][1] > 0.9
+
+
+def test_fused_fit_exports_optimizer_state(tmp_path):
+    m = _fit(True, "sgd", {"learning_rate": 0.01, "momentum": 0.9})
+    # momentum exported into the updater: nonzero after training
+    states = {k: v for k, v in m._updater.states.items() if v is not None}
+    assert states, "no optimizer state exported"
+    some = next(iter(states.values()))
+    assert float(np.abs(some.asnumpy()).max()) > 0
+    m.save_optimizer_states(str(tmp_path / "opt.states"))
+    m.load_optimizer_states(str(tmp_path / "opt.states"))
+
+
+def test_fused_fit_gates():
+    # fixed params -> general path (no fused cache)
+    m = _fit(True, "sgd", fixed=["fc1_weight"], num_epoch=1)
+    assert getattr(m, "_fused_ts_cache", None) is None
+    # unsupported optimizer -> general path, still trains
+    m2 = _fit(True, "sgld", num_epoch=1)
+    assert getattr(m2, "_fused_ts_cache", None) is None
+
+
+def test_fused_fit_off_switch():
+    m = _fit(False, "sgd", num_epoch=1)
+    assert getattr(m, "_fused_ts_cache", None) is None
