@@ -11,7 +11,7 @@ Layout: tokens (B, T) -> embedding (B, T, C) -> N blocks of
 from .. import symbol as sym
 
 
-def _mha(x, name, seq_len, num_heads, num_hidden):
+def _mha(x, name, seq_len, num_heads, num_hidden, attn_impl=None):
     """Multi-head causal self-attention from MXU-visible primitives."""
     head = num_hidden // num_heads
     qkv = sym.FullyConnected(x, num_hidden=3 * num_hidden, no_bias=False,
@@ -25,7 +25,9 @@ def _mha(x, name, seq_len, num_heads, num_hidden):
     v = sym.Reshape(sym.slice_axis(qkv, axis=0, begin=2, end=3),
                     shape=(-3, -2), name="%s_v" % name)
     att = sym.dot_product_attention(q, k, v, causal=True,
-                                    name="%s_attn" % name)   # (B,H,T,D)
+                                    name="%s_attn" % name,
+                                    **({"impl": attn_impl}
+                                       if attn_impl else {}))  # (B,H,T,D)
     att = sym.transpose(att, axes=(0, 2, 1, 3))              # (B,T,H,D)
     att = sym.Reshape(att, shape=(-1, num_hidden))           # (B*T, C)
     return sym.FullyConnected(att, num_hidden=num_hidden,
@@ -37,7 +39,7 @@ def _ln(x, name):
 
 
 def get_symbol(vocab_size=1000, seq_len=128, num_layers=2, num_hidden=128,
-               num_heads=4, **kwargs):
+               num_heads=4, attn_impl=None, **kwargs):
     """Causal LM head symbol; data (B, T) int tokens, label (B, T)."""
     data = sym.Variable("data")
     label = sym.Variable("softmax_label")
@@ -51,7 +53,7 @@ def get_symbol(vocab_size=1000, seq_len=128, num_layers=2, num_hidden=128,
     for i in range(num_layers):
         name = "layer%d" % i
         a = _mha(_ln(x, "%s_ln1" % name), name, seq_len, num_heads,
-                 num_hidden)
+                 num_hidden, attn_impl=attn_impl)
         x = x + a
         h = sym.FullyConnected(_ln(x, "%s_ln2" % name),
                                num_hidden=4 * num_hidden,

@@ -536,9 +536,17 @@ class _FusedFit(object):
         and export the fused optimizer state into the Updater so
         save_optimizer_states reflects the training that actually happened."""
         import jax
+        import jax.numpy as jnp
         mod = self._mod
-        arg = {n: nd.NDArray(v) for n, v in self._params.items()}
-        aux = {n: nd.NDArray(v) for n, v in self._aux.items()}
+        # COPIES, not aliases: the next fused step donates self._params/
+        # _state/_aux to XLA — anything installed in the executors, kvstore
+        # or updater must own its buffer or it dies with the donation
+        params_cp = {n: jnp.copy(v) for n, v in self._params.items()}
+        state_cp = {n: tuple(jnp.copy(s) for s in st)
+                    for n, st in self._state.items()}
+        aux_cp = {n: jnp.copy(v) for n, v in self._aux.items()}
+        arg = {n: nd.NDArray(v) for n, v in params_cp.items()}
+        aux = {n: nd.NDArray(v) for n, v in aux_cp.items()}
         mod._exec_group.set_params(arg, aux)
         if mod._arg_params is not None:
             # ONE device->host transfer: concatenate on device, split on host
@@ -568,13 +576,22 @@ class _FusedFit(object):
             if store:
                 for idx, name in enumerate(self._ts.param_names):
                     if idx in store:
-                        store[idx]._set_value(self._params[name])
+                        store[idx]._set_value(params_cp[name])
+        # continue the optimizer's update counts (Adam bias correction, lr
+        # schedules) — _import_updater_state reads these back on the next fit
+        opt = mod._optimizer
+        if hasattr(opt, "_index_update_count"):
+            for idx in range(len(self._ts.param_names)):
+                opt._index_update_count[idx] = self._ts.num_update
+        if hasattr(opt, "num_update"):
+            opt.num_update = max(getattr(opt, "num_update", 0),
+                                 self._ts.num_update)
         updater = self._updater()
         if updater is None:
             return
         kind = self._ts.fopt.kind
         for idx, name in enumerate(self._ts.param_names):
-            st = tuple(nd.NDArray(s) for s in self._state[name])
+            st = tuple(nd.NDArray(s) for s in state_cp[name])
             # mirror each Optimizer.create_state layout (optimizer.py)
             if kind in ("sgd", "ccsgd", "nag"):
                 updater.states[idx] = st[0] if st else None

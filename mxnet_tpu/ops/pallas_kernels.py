@@ -30,7 +30,7 @@ def flash_available(q_shape, k_shape=None, v_shape=None, block_q=128,
     """Shape guard: self-attention only (q/k/v shapes equal), T divisible
     into blocks, D lane-friendly, and one head's K+V must fit VMEM (the
     kernel keeps a (T, D) K and V slice resident while Q is tiled)."""
-    if len(q_shape) != 4:
+    if pl is None or len(q_shape) != 4:
         return False
     for other in (k_shape, v_shape):
         if other is not None and tuple(other) != tuple(q_shape):
@@ -133,11 +133,141 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     return out, (q, k, v, out, lse)
 
 
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, block_q, block_k, seq_len):
+    """dQ: one Q-tile resident, K/V blocks stream (mirrors the forward)."""
+    j = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]                       # (bq, 1) f32
+    delta = delta_ref[0]                   # (bq, 1) f32
+    bq, d = q.shape
+    q_pos = j * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    def fold(kb, dq):
+        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ()))) * scale
+        if causal:
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)               # masked entries underflow to 0
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot(ds, kblk)
+
+    if causal:
+        num_kb = ((j + 1) * block_q + block_k - 1) // block_k
+    else:
+        num_kb = seq_len // block_k
+    dq = jax.lax.fori_loop(0, num_kb, fold, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dk_ref,
+                dv_ref, *, scale, causal, block_q, block_k, seq_len):
+    """dK/dV: one K/V-tile resident, Q/dO blocks stream; causal skips the
+    Q-blocks strictly above the diagonal."""
+    j = pl.program_id(1)
+    kblk = k_ref[0].astype(jnp.float32)    # (bk, d)
+    vblk = v_ref[0].astype(jnp.float32)
+    bk, d = kblk.shape
+    k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+
+    def fold(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ()))) * scale
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)               # (bq, bk)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))
+        return dk, dv
+
+    start_qb = (j * block_k) // block_q if causal else 0
+    zeros = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start_qb, seq_len // block_q, fold,
+                               (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    """Blocked flash backward as TWO Pallas kernels (dq; dk+dv), recomputing
+    scores against the saved log-sum-exp — the (T, T) matrix never
+    materialises, all matmuls on the MXU, f32 accumulators."""
+    q, k, v, out, lse = res
+    b, h, t, d = q.shape
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    # delta = rowsum(dO * O): one fused elementwise+reduce pass in XLA
+    delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(
+        axis=-1, keepdims=True)
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, t, d)
+    vf = v.reshape(b * h, t, d)
+    gf = g.reshape(b * h, t, d)
+    lsef = lse.reshape(b * h, t, 1)
+    deltaf = delta.reshape(b * h, t, 1)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=sc, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=t),
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, gf, lsef, deltaf)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=sc, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=t),
+        grid=(b * h, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, gf, lsef, deltaf, kf, vf)
+    return (dq.reshape(b, h, t, d), dk.reshape(b, h, t, d),
+            dv.reshape(b, h, t, d))
+
+
+def _flash_bwd_xla(causal, scale, block_q, block_k, interpret, res, g):
     """Blocked flash backward (pure XLA): recompute scores one K-block at a
     time against the saved log-sum-exp, so the (T, T) matrix never
     materialises in the backward either — O(T·block) live memory, matmuls
-    on the MXU."""
+    on the MXU.  Kept as the reference implementation the Pallas kernels
+    are tested against (the forward itself requires pallas, so this is not
+    a runtime fallback — flash_available gates on pl)."""
     q, k, v, out, lse = res
     b, h, t, d = q.shape
     sc = scale if scale is not None else 1.0 / math.sqrt(d)

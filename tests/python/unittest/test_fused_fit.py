@@ -85,3 +85,33 @@ def test_fused_fit_gates():
 def test_fused_fit_off_switch():
     m = _fit(False, "sgd", num_epoch=1)
     assert getattr(m, "_fused_ts_cache", None) is None
+
+
+def test_fused_fit_no_donated_aliases():
+    """sync_back must install COPIES: the next fused step donates the fused
+    buffers, so aliased executor/kvstore/updater arrays would die.  A second
+    fit + score after it exercises exactly that."""
+    np.random.seed(0)
+    x = np.random.randn(90, 1, 12, 12).astype(np.float32)
+    y = np.random.randint(0, 3, 90).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=30)
+    net = models.get_mlp(num_classes=3) if hasattr(models, "get_mlp") \
+        else models.get_lenet(num_classes=3)
+    mod = mx.Module(net)
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01, "momentum": 0.9})
+    it.reset()
+    # second fit: first step donates; previously-installed buffers must live
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01, "momentum": 0.9},
+            force_init=False)
+    # executor/updater state must be usable afterwards
+    score = mod.score(mx.io.NDArrayIter(x, y, batch_size=30),
+                      mx.metric.Accuracy())
+    assert np.isfinite(score[0][1])
+    states = {k: v for k, v in mod._updater.states.items() if v is not None}
+    for v in states.values():
+        arr = v.asnumpy() if not isinstance(v, tuple) else v[0].asnumpy()
+        assert np.isfinite(arr).all()
+    # update counts continued across fits (Adam bias correction / schedules)
+    assert max(mod._optimizer._index_update_count.values()) >= 12

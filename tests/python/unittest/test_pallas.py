@@ -51,6 +51,28 @@ def test_flash_gradients():
                                    rtol=3e-3, atol=3e-4)
 
 
+@pytest.mark.parametrize("causal,bq,bk", [(False, 64, 64), (True, 64, 32),
+                                          (True, 32, 64)])
+def test_flash_pallas_backward_blocks(causal, bq, bk):
+    """The Pallas dq/dkv kernels across block aspect ratios (the causal
+    start-block arithmetic differs when block_q != block_k)."""
+    q, k, v = _qkv(T=128, seed=5)
+
+    def lf(q, k, v):
+        return (flash_attention(q, k, v, causal, None, bq, bk, True)
+                * jnp.cos(q)).sum()
+
+    def lr(q, k, v):
+        return (attention_reference(q, k, v, causal=causal)
+                * jnp.cos(q)).sum()
+
+    g1 = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-3, atol=3e-4)
+
+
 def test_flash_available_guard():
     assert flash_available((2, 2, 1024, 64))
     assert not flash_available((2, 2, 100, 64))    # T not block-divisible
