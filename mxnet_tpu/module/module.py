@@ -537,13 +537,12 @@ class _FusedFit(object):
         save_optimizer_states reflects the training that actually happened."""
         import jax
         import jax.numpy as jnp
+        import numpy as _np
         mod = self._mod
         # COPIES, not aliases: the next fused step donates self._params/
         # _state/_aux to XLA — anything installed in the executors, kvstore
         # or updater must own its buffer or it dies with the donation
         params_cp = {n: jnp.copy(v) for n, v in self._params.items()}
-        state_cp = {n: tuple(jnp.copy(s) for s in st)
-                    for n, st in self._state.items()}
         aux_cp = {n: jnp.copy(v) for n, v in self._aux.items()}
         arg = {n: nd.NDArray(v) for n, v in params_cp.items()}
         aux = {n: nd.NDArray(v) for n, v in aux_cp.items()}
@@ -552,8 +551,6 @@ class _FusedFit(object):
             # ONE device->host transfer: concatenate on device, split on host
             # (jax.device_get fetches leaf by leaf — a round trip each on a
             # tunneled TPU)
-            import jax.numpy as jnp
-            import numpy as _np
             items = [("arg", n, v) for n, v in sorted(self._params.items())] \
                 + [("aux", n, v) for n, v in sorted(self._aux.items())]
             flat = _np.asarray(jnp.concatenate(
@@ -589,6 +586,10 @@ class _FusedFit(object):
         updater = self._updater()
         if updater is None:
             return
+        # optimizer-state copies only when someone will hold them (the
+        # donation-alias hazard applies to these too)
+        state_cp = {n: tuple(jnp.copy(s) for s in st)
+                    for n, st in self._state.items()}
         kind = self._ts.fopt.kind
         for idx, name in enumerate(self._ts.param_names):
             st = tuple(nd.NDArray(s) for s in state_cp[name])

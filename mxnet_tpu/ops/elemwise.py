@@ -84,14 +84,24 @@ def _identity_like_rhs(lhs, rhs):
 
 
 # ----------------------------------------------------------------- binary ops
+def _maximum_f(a, b):
+    # where-form so ties route the FULL gradient to lhs (reference
+    # mshadow_op::ge semantics; jnp.maximum splits 0.5/0.5 at ties)
+    return jnp.where(a >= b, a, b)
+
+
+def _minimum_f(a, b):
+    return jnp.where(a <= b, a, b)
+
+
 _BINARY = {
     "_plus": (jnp.add, ("_add", "elemwise_add")),
     "_minus": (jnp.subtract, ("_sub", "elemwise_sub")),
     "_mul": (jnp.multiply, ("elemwise_mul",)),
     "_div": (jnp.divide, ("elemwise_div",)),
     "_power": (jnp.power, ()),
-    "_maximum": (jnp.maximum, ()),
-    "_minimum": (jnp.minimum, ()),
+    "_maximum": (_maximum_f, ()),
+    "_minimum": (_minimum_f, ()),
     "_hypot": (jnp.hypot, ()),
     "_grad_add": (jnp.add, ()),
     "_equal": (lambda a, b: (a == b).astype(a.dtype), ()),
@@ -114,8 +124,8 @@ _BCAST = {
     "broadcast_mul": jnp.multiply,
     "broadcast_div": jnp.divide,
     "broadcast_power": jnp.power,
-    "broadcast_maximum": jnp.maximum,
-    "broadcast_minimum": jnp.minimum,
+    "broadcast_maximum": _maximum_f,
+    "broadcast_minimum": _minimum_f,
     "broadcast_hypot": jnp.hypot,
     "broadcast_equal": lambda a, b: (a == b).astype(a.dtype),
     "broadcast_not_equal": lambda a, b: (a != b).astype(a.dtype),
@@ -143,8 +153,8 @@ _SCALAR = {
     "_rdiv_scalar": lambda x, s: s / x,
     "_power_scalar": lambda x, s: jnp.power(x, s),
     "_rpower_scalar": lambda x, s: jnp.power(s, x),
-    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
-    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_maximum_scalar": lambda x, s: _maximum_f(x, jnp.asarray(s, x.dtype)),
+    "_minimum_scalar": lambda x, s: _minimum_f(x, jnp.asarray(s, x.dtype)),
     "_hypot_scalar": lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)),
     "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
     "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),

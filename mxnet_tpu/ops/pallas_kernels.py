@@ -4,9 +4,10 @@ XLA fusion is insufficient").
 ``flash_attention``: blocked attention forward that never materialises the
 (T, T) score matrix — Q tiles stay resident in VMEM while K/V blocks stream
 through, folded with the online-softmax recurrence (running max ``m``,
-normaliser ``l``, f32 accumulator).  The backward pass recomputes through
-the XLA reference expression under ``jax.custom_vjp`` (flash-style
-recompute: O(T) memory in both directions).
+normaliser ``l``, f32 accumulator).  The backward pass is two further
+Pallas kernels (``_dq_kernel``, ``_dkv_kernel``) recomputing scores against
+the saved log-sum-exp under ``jax.custom_vjp`` (flash-style recompute:
+O(T) memory in both directions).
 
 Used by ``dot_product_attention`` (ops/attention.py) on TPU for long
 sequences; everything is shape-guarded so XLA's fused attention remains the

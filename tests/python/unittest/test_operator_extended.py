@@ -1,0 +1,563 @@
+"""Extended operator tests (VERDICT r2 #9 — depth toward the reference's
+tests/python/unittest/test_operator.py, 2,948 LoC):
+
+1. a bf16 consistency sweep across op families (the reference model:
+   check_consistency over ctx/dtype lists, test_utils.py:676 — bf16 is the
+   recommended training dtype, so every family must agree with f32 within
+   bf16 tolerance);
+2. numeric gradients for the spatial / sequence / ordering families;
+3. ports of high-value reference cases: dot transpose variants, gradient
+   routing through maximum/minimum/clip, pad/tile/repeat/reverse backward,
+   grad_req='add' accumulation, softmax axis semantics, sampling moments.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.test_utils import (assert_almost_equal, check_consistency,
+                                  check_numeric_gradient,
+                                  check_symbolic_backward,
+                                  check_symbolic_forward)
+
+RS = np.random.RandomState
+
+
+# =====================================================================
+# 1. bf16 consistency sweep (f32 vs bf16 forward + backward per family)
+# =====================================================================
+_BF16 = jnp.bfloat16
+# bf16 keeps 8 mantissa bits — coarser than f16 (11 bits), whose tolerance
+# in the reference's check_consistency is 1e-1 (test_utils.py:676); conv
+# reductions accumulate that per-element noise
+_BF16_TOL = {np.dtype(np.float32): 1e-3, np.dtype(np.float64): 1e-5,
+             np.dtype(_BF16): 1.5e-1, np.dtype(np.float16): 1e-1,
+             np.dtype(np.uint8): 0, np.dtype(np.int32): 0}
+
+
+def _bf16_ctx_list(symbol, **shapes):
+    # bf16 for EVERY argument (incl. auto-created weights), not just the
+    # data inputs — a mixed binding promotes the outputs back to f32 and
+    # the sweep would silently compare f32 against f32
+    args = symbol.list_arguments()
+    return [{"ctx": mx.cpu(), "type_dict": {k: np.float32 for k in args},
+             **shapes},
+            {"ctx": mx.cpu(), "type_dict": {k: _BF16 for k in args},
+             **shapes}]
+
+
+def _sweep(symbol, grad_req="write", scale=1.0, **shapes):
+    check_consistency(symbol, _bf16_ctx_list(symbol, **shapes),
+                      tol=_BF16_TOL, grad_req=grad_req, scale=scale)
+
+
+def test_bf16_fully_connected():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc")
+    _sweep(net, data=(4, 10))
+
+
+def test_bf16_convolution():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                          name="conv")
+    # conv grads accumulate hundreds of bf16 products (bias grad sums
+    # N*H*W terms) — noise grows ~sqrt(n)*eps_bf16 past the family default
+    tol = dict(_BF16_TOL)
+    tol[np.dtype(_BF16)] = 2.5e-1
+    check_consistency(net, _bf16_ctx_list(net, data=(2, 3, 10, 10)),
+                      tol=tol)
+
+
+def test_bf16_deconvolution():
+    data = sym.Variable("data")
+    net = sym.Deconvolution(data, kernel=(3, 3), num_filter=5, stride=(2, 2),
+                            name="deconv")
+    _sweep(net, data=(2, 3, 7, 7))
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg", "sum"])
+def test_bf16_pooling(pool_type):
+    data = sym.Variable("data")
+    net = sym.Pooling(data, kernel=(2, 2), stride=(2, 2),
+                      pool_type=pool_type)
+    _sweep(net, data=(2, 3, 8, 8))
+
+
+def test_bf16_batchnorm():
+    data = sym.Variable("data")
+    net = sym.BatchNorm(data, fix_gamma=False, name="bn")
+    _sweep(net, data=(4, 3, 6, 6))
+
+
+@pytest.mark.parametrize("act", ["relu", "sigmoid", "tanh", "softrelu"])
+def test_bf16_activation(act):
+    data = sym.Variable("data")
+    net = sym.Activation(data, act_type=act)
+    _sweep(net, data=(4, 10))
+
+
+@pytest.mark.parametrize("act", ["leaky", "elu"])
+def test_bf16_leaky_relu(act):
+    data = sym.Variable("data")
+    net = sym.LeakyReLU(data, act_type=act)
+    _sweep(net, data=(4, 10))
+
+
+@pytest.mark.parametrize("op", ["broadcast_add", "broadcast_mul",
+                                "broadcast_maximum", "broadcast_div"])
+def test_bf16_broadcast_binary(op):
+    lhs, rhs = sym.Variable("lhs"), sym.Variable("rhs")
+    net = getattr(sym, op)(lhs, rhs)
+    # denominators away from zero for div
+    check_consistency(net, _bf16_ctx_list(net, lhs=(4, 1, 5), rhs=(1, 3, 5)),
+                      tol=_BF16_TOL,
+                      arg_params={"rhs": RS(0).rand(1, 3, 5).astype(
+                          np.float32) + 1.0})
+
+
+@pytest.mark.parametrize("op,kw", [("sum", {"axis": 1}),
+                                   ("mean", {"axis": (0, 2)}),
+                                   ("max", {"axis": 1}),
+                                   ("prod", {"axis": 2})])
+def test_bf16_reduce(op, kw):
+    data = sym.Variable("data")
+    net = getattr(sym, op)(data, **kw)
+    _sweep(net, scale=0.5, data=(3, 4, 5))
+
+
+def test_bf16_dot_batchdot():
+    lhs, rhs = sym.Variable("lhs"), sym.Variable("rhs")
+    _sweep(sym.dot(lhs, rhs), lhs=(6, 7), rhs=(7, 5))
+    _sweep(sym.batch_dot(lhs, rhs), lhs=(3, 4, 5), rhs=(3, 5, 6))
+
+
+def test_bf16_softmax_family():
+    data = sym.Variable("data")
+    _sweep(sym.softmax(data, axis=-1), data=(4, 10))
+    _sweep(sym.log_softmax(data, axis=-1), data=(4, 10))
+    label = sym.Variable("softmax_label")
+    net = sym.SoftmaxOutput(data, label, name="softmax")
+    arg = {"softmax_label": RS(0).randint(0, 10, (4,)).astype(np.float32)}
+    check_consistency(net, _bf16_ctx_list(net, data=(4, 10),
+                                          softmax_label=(4,)),
+                      tol=_BF16_TOL, arg_params=arg)
+
+
+def test_bf16_embedding_concat_transpose():
+    data = sym.Variable("data")
+    emb = sym.Embedding(data, input_dim=20, output_dim=8, name="embed")
+    idx = {"data": RS(0).randint(0, 20, (4, 5)).astype(np.float32)}
+    check_consistency(emb, _bf16_ctx_list(emb, data=(4, 5)), tol=_BF16_TOL,
+                      arg_params=idx)
+    a, b = sym.Variable("a"), sym.Variable("b")
+    _sweep(sym.Concat(a, b, dim=1, num_args=2), a=(2, 3, 4), b=(2, 5, 4))
+    _sweep(sym.transpose(sym.Variable("data"), axes=(2, 0, 1)),
+           data=(3, 4, 5))
+
+
+def test_bf16_norm_family():
+    data = sym.Variable("data")
+    _sweep(sym.LRN(data, nsize=3), data=(2, 6, 5, 5))
+    _sweep(sym.L2Normalization(data), data=(4, 10))
+    net = sym.InstanceNorm(sym.Variable("data"), name="in")
+    _sweep(net, data=(2, 3, 6, 6))
+
+
+# =====================================================================
+# 2. numeric gradients: spatial / sequence / ordering families
+# =====================================================================
+def test_grad_bilinear_sampler():
+    data = sym.Variable("data")
+    grid = sym.Variable("grid")
+    net = sym.BilinearSampler(data, grid)
+    d = RS(0).rand(2, 3, 6, 6).astype(np.float32)
+    # keep sample points interior so bilinear weights are smooth
+    g = (RS(1).rand(2, 2, 5, 5).astype(np.float32) - 0.5) * 1.2
+    check_numeric_gradient(net, {"data": d, "grid": g}, numeric_eps=1e-3,
+                           rtol=2e-2, atol=2e-3)
+
+
+def test_grad_grid_generator_affine():
+    loc = sym.Variable("loc")
+    net = sym.GridGenerator(loc, transform_type="affine",
+                            target_shape=(6, 6))
+    theta = np.array([[1.0, 0.1, 0.2, -0.1, 0.9, 0.05]], np.float32)
+    check_numeric_gradient(net, {"loc": theta}, numeric_eps=1e-3, rtol=2e-2,
+                           atol=2e-3)
+
+
+def test_grad_spatial_transformer():
+    data = sym.Variable("data")
+    loc = sym.Variable("loc")
+    net = sym.SpatialTransformer(data, loc, target_shape=(5, 5),
+                                 transform_type="affine",
+                                 sampler_type="bilinear")
+    d = RS(0).rand(1, 2, 7, 7).astype(np.float32)
+    theta = np.array([[0.9, 0.05, 0.1, -0.05, 1.05, -0.1]], np.float32)
+    # bilinear sampling is piecewise-linear: finite differences straddle
+    # cell-boundary kinks, so the check needs slack (reference test_operator
+    # uses the same pattern for SpatialTransformer)
+    check_numeric_gradient(net, {"data": d, "loc": theta}, numeric_eps=2e-3,
+                           rtol=1e-1, atol=1e-2)
+
+
+def test_roi_pooling_forward_and_grad():
+    data = sym.Variable("data")
+    rois = sym.Variable("rois")
+    net = sym.ROIPooling(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    d = RS(0).rand(1, 2, 8, 8).astype(np.float32)
+    r = np.array([[0, 0, 0, 5, 5], [0, 2, 2, 7, 7]], np.float32)
+    ex = net.bind(mx.cpu(), {"data": mx.nd.array(d), "rois": mx.nd.array(r)},
+                  args_grad={"data": mx.nd.zeros(d.shape)},
+                  grad_req={"data": "write", "rois": "null"})
+    out = ex.forward(is_train=True)[0].asnumpy()
+    # roi 0: max over [0,5]x[0,5] quadrants
+    sub = d[0, :, 0:6, 0:6]
+    expect00 = sub[:, :3, :3].max(axis=(1, 2))
+    assert_almost_equal(out[0, :, 0, 0], expect00, rtol=1e-5, atol=1e-6)
+    ex.backward([mx.nd.ones(out.shape)])
+    gd = ex.grad_dict["data"].asnumpy()
+    # max-pool routing: gradient count equals number of pooled cells
+    assert gd.sum() == pytest.approx(out.size, rel=1e-5)
+
+
+def test_correlation_numeric_grad():
+    a, b = sym.Variable("data1"), sym.Variable("data2")
+    net = sym.Correlation(a, b, kernel_size=1, max_displacement=1,
+                          stride1=1, stride2=1, pad_size=1)
+    d1 = RS(0).rand(1, 2, 5, 5).astype(np.float32)
+    d2 = RS(1).rand(1, 2, 5, 5).astype(np.float32)
+    check_numeric_gradient(net, {"data1": d1, "data2": d2},
+                           numeric_eps=1e-3, rtol=3e-2, atol=3e-3)
+
+
+def test_sequence_ops_with_lengths_grads():
+    data = sym.Variable("data")
+    slen = sym.Variable("slen")
+    d = RS(0).rand(5, 3, 4).astype(np.float32)   # (T, B, C)
+    lens = np.array([5, 3, 1], np.float32)
+
+    last = sym.SequenceLast(data, slen, use_sequence_length=True)
+    ex = last.bind(mx.cpu(), {"data": mx.nd.array(d),
+                              "slen": mx.nd.array(lens)},
+                   args_grad={"data": mx.nd.zeros(d.shape)},
+                   grad_req={"data": "write", "slen": "null"})
+    out = ex.forward(is_train=True)[0].asnumpy()
+    expect = np.stack([d[4, 0], d[2, 1], d[0, 2]])
+    assert_almost_equal(out, expect, rtol=1e-6, atol=1e-7)
+    ex.backward([mx.nd.ones(out.shape)])
+    gd = ex.grad_dict["data"].asnumpy()
+    assert gd.sum() == pytest.approx(out.size)
+    assert gd[4, 0].sum() == pytest.approx(4)    # routed to t=len-1 only
+    assert gd[3, 0].sum() == 0
+
+    mask = sym.SequenceMask(data, slen, use_sequence_length=True,
+                            value=-1.0)
+    ex2 = mask.bind(mx.cpu(), {"data": mx.nd.array(d),
+                               "slen": mx.nd.array(lens)},
+                    grad_req="null")
+    m = ex2.forward()[0].asnumpy()
+    assert (m[3:, 1] == -1).all() and (m[1:, 2] == -1).all()
+    assert_almost_equal(m[:3, 1], d[:3, 1], rtol=1e-6, atol=1e-7)
+
+    rev = sym.SequenceReverse(data, slen, use_sequence_length=True)
+    r = rev.bind(mx.cpu(), {"data": mx.nd.array(d),
+                            "slen": mx.nd.array(lens)},
+                 grad_req="null").forward()[0].asnumpy()
+    assert_almost_equal(r[:, 0], d[::-1, 0], rtol=1e-6, atol=1e-7)
+    assert_almost_equal(r[0, 1], d[2, 1], rtol=1e-6, atol=1e-7)
+    assert_almost_equal(r[3:, 1], d[3:, 1], rtol=1e-6, atol=1e-7)
+
+
+def test_ordering_grads_and_determinism():
+    data = sym.Variable("data")
+    d = RS(0).permutation(24).reshape(4, 6).astype(np.float32)
+    # sort gradient: permutation routing
+    srt = sym.sort(data, axis=1)
+    ex = srt.bind(mx.cpu(), {"data": mx.nd.array(d)},
+                  args_grad={"data": mx.nd.zeros(d.shape)})
+    out = ex.forward(is_train=True)[0].asnumpy()
+    og = np.arange(24, dtype=np.float32).reshape(4, 6)
+    ex.backward([mx.nd.array(og)])
+    gd = ex.grad_dict["data"].asnumpy()
+    order = np.argsort(d, axis=1)
+    expect = np.zeros_like(d)
+    for i in range(4):
+        expect[i, order[i]] = og[i]
+    assert_almost_equal(gd, expect, rtol=1e-6, atol=1e-7)
+    # topk value mode matches numpy
+    tk = sym.topk(data, axis=1, k=3, ret_typ="value")
+    tv = tk.bind(mx.cpu(), {"data": mx.nd.array(d)},
+                 grad_req="null").forward()[0].asnumpy()
+    assert_almost_equal(tv, -np.sort(-d, axis=1)[:, :3], rtol=1e-6,
+                        atol=1e-7)
+    # argsort determinism on ties
+    tie = np.zeros((2, 5), np.float32)
+    ags = sym.argsort(sym.Variable("data"), axis=1)
+    av = ags.bind(mx.cpu(), {"data": mx.nd.array(tie)},
+                  grad_req="null").forward()[0].asnumpy()
+    assert_almost_equal(av, np.tile(np.arange(5, dtype=np.float32), (2, 1)),
+                        rtol=0, atol=0)
+
+
+def test_sampling_moments_and_determinism():
+    mx.random.seed(1234)
+    u = mx.nd.uniform(low=-2.0, high=3.0, shape=(50000,)).asnumpy()
+    assert abs(u.mean() - 0.5) < 0.05
+    assert abs(u.min() + 2.0) < 1e-2 and abs(u.max() - 3.0) < 1e-2
+    n = mx.nd.normal(loc=1.0, scale=2.0, shape=(50000,)).asnumpy()
+    assert abs(n.mean() - 1.0) < 0.05
+    assert abs(n.std() - 2.0) < 0.05
+    mx.random.seed(1234)
+    u2 = mx.nd.uniform(low=-2.0, high=3.0, shape=(50000,)).asnumpy()
+    assert_almost_equal(u, u2, rtol=0, atol=0)
+
+
+# =====================================================================
+# 3. ported high-value reference cases
+# =====================================================================
+@pytest.mark.parametrize("ta,tb", [(False, False), (True, False),
+                                   (False, True), (True, True)])
+def test_dot_transpose_variants(ta, tb):
+    a = RS(0).rand(4, 5).astype(np.float32)
+    b = RS(1).rand(5, 6).astype(np.float32)
+    la = a.T.copy() if ta else a
+    lb = b.T.copy() if tb else b
+    lhs, rhs = sym.Variable("lhs"), sym.Variable("rhs")
+    net = sym.dot(lhs, rhs, transpose_a=ta, transpose_b=tb)
+    expect = (la.T if ta else la) @ (lb.T if tb else lb)
+    check_symbolic_forward(net, {"lhs": la, "rhs": lb}, [expect])
+    check_numeric_gradient(net, {"lhs": la, "rhs": lb}, rtol=2e-2,
+                           atol=2e-3)
+
+
+def test_maximum_minimum_grad_routing():
+    a = np.array([[1.0, 5.0], [3.0, 2.0]], np.float32)
+    b = np.array([[2.0, 4.0], [3.0, 1.0]], np.float32)
+    lhs, rhs = sym.Variable("lhs"), sym.Variable("rhs")
+    og = np.array([[10.0, 20.0], [30.0, 40.0]], np.float32)
+    check_symbolic_backward(sym._maximum(lhs, rhs), {"lhs": a, "rhs": b},
+                            [og],
+                            [og * (a >= b), og * (a < b)])
+    check_symbolic_backward(sym._minimum(lhs, rhs), {"lhs": a, "rhs": b},
+                            [og],
+                            [og * (a <= b), og * (a > b)])
+
+
+def test_clip_grad_boundaries():
+    data = sym.Variable("data")
+    d = np.array([-2.0, -1.0, 0.0, 1.0, 2.0], np.float32)
+    og = np.ones(5, np.float32)
+    net = sym.clip(data, a_min=-1.0, a_max=1.0)
+    check_symbolic_forward(net, {"data": d}, [np.clip(d, -1, 1)])
+    # gradient flows only strictly inside the clip range (reference
+    # mshadow_op clip grad: 0 at and beyond the boundary values' exterior)
+    ex = net.bind(mx.cpu(), {"data": mx.nd.array(d)},
+                  args_grad={"data": mx.nd.zeros(5)})
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.array(og)])
+    gd = ex.grad_dict["data"].asnumpy()
+    assert gd[0] == 0 and gd[4] == 0 and gd[2] == 1
+
+
+@pytest.mark.parametrize("mode", ["constant", "edge"])
+def test_pad_backward(mode):
+    data = sym.Variable("data")
+    net = sym.Pad(data, mode=mode, pad_width=(0, 0, 0, 0, 1, 2, 2, 1))
+    d = RS(0).rand(1, 2, 3, 3).astype(np.float32)
+    check_numeric_gradient(net, {"data": d}, rtol=2e-2, atol=2e-3)
+
+
+def test_tile_repeat_reverse_backward():
+    data = sym.Variable("data")
+    d = RS(0).rand(2, 3).astype(np.float32)
+    for net in (sym.tile(data, reps=(2, 3)),
+                sym.repeat(data, repeats=2, axis=1),
+                sym.reverse(data, axis=1)):
+        check_numeric_gradient(net, {"data": d}, rtol=2e-2, atol=2e-3)
+
+
+def test_grad_req_add_accumulates():
+    data = sym.Variable("data")
+    net = sym.sum(data * data)
+    d = RS(0).rand(3, 4).astype(np.float32)
+    grad = mx.nd.zeros((3, 4))
+    ex = net.bind(mx.cpu(), {"data": mx.nd.array(d)},
+                  args_grad={"data": grad}, grad_req="add")
+    for _ in range(3):
+        ex.forward(is_train=True)
+        ex.backward()
+    assert_almost_equal(grad.asnumpy(), 3 * 2 * d, rtol=1e-5, atol=1e-6)
+
+
+def test_embedding_grad_accumulation_repeated_ids():
+    data = sym.Variable("data")
+    weight = sym.Variable("embed_weight")
+    net = sym.Embedding(data, weight=weight, input_dim=5, output_dim=3,
+                        name="embed")
+    ids = np.array([1, 1, 1, 2], np.float32)
+    w = RS(0).rand(5, 3).astype(np.float32)
+    ex = net.bind(mx.cpu(), {"data": mx.nd.array(ids),
+                             "embed_weight": mx.nd.array(w)},
+                  args_grad={"embed_weight": mx.nd.zeros((5, 3))},
+                  grad_req={"data": "null", "embed_weight": "write"})
+    ex.forward(is_train=True)
+    og = np.ones((4, 3), np.float32)
+    ex.backward([mx.nd.array(og)])
+    gw = ex.grad_dict["embed_weight"].asnumpy()
+    assert_almost_equal(gw[1], np.full(3, 3.0), rtol=1e-6, atol=1e-7)
+    assert_almost_equal(gw[2], np.ones(3), rtol=1e-6, atol=1e-7)
+    assert (gw[[0, 3, 4]] == 0).all()
+
+
+def test_softmax_axis_semantics():
+    data = sym.Variable("data")
+    d = RS(0).rand(2, 3, 4).astype(np.float32)
+    for axis in (0, 1, 2, -1):
+        net = sym.softmax(data, axis=axis)
+        out = net.bind(mx.cpu(), {"data": mx.nd.array(d)},
+                       grad_req="null").forward()[0].asnumpy()
+        e = np.exp(d - d.max(axis=axis, keepdims=True))
+        assert_almost_equal(out, e / e.sum(axis=axis, keepdims=True),
+                            rtol=1e-5, atol=1e-6)
+
+
+def test_batchnorm_fix_gamma_blocks_gamma_grad():
+    data = sym.Variable("data")
+    net = sym.BatchNorm(data, fix_gamma=True, name="bn")
+    d = RS(0).rand(4, 3, 5, 5).astype(np.float32)
+    args = {"data": mx.nd.array(d), "bn_gamma": mx.nd.ones(3),
+            "bn_beta": mx.nd.zeros(3)}
+    grads = {k: mx.nd.zeros(v.shape) for k, v in args.items()}
+    ex = net.bind(mx.cpu(), args, args_grad=grads,
+                  aux_states={"bn_moving_mean": mx.nd.zeros(3),
+                              "bn_moving_var": mx.nd.ones(3)})
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.ones(d.shape)])
+    assert float(np.abs(ex.grad_dict["bn_gamma"].asnumpy()).max()) == 0
+    assert float(np.abs(ex.grad_dict["bn_beta"].asnumpy()).max()) > 0
+
+
+def test_take_modes_and_one_hot():
+    a = sym.Variable("a")
+    idx = sym.Variable("idx")
+    w = RS(0).rand(5, 3).astype(np.float32)
+    ii = np.array([0, 4, 2], np.float32)
+    out = sym.take(a, idx).bind(
+        mx.cpu(), {"a": mx.nd.array(w), "idx": mx.nd.array(ii)},
+        grad_req="null").forward()[0].asnumpy()
+    assert_almost_equal(out, w[[0, 4, 2]], rtol=1e-6, atol=1e-7)
+    oh = sym.one_hot(idx, depth=5).bind(
+        mx.cpu(), {"idx": mx.nd.array(ii)},
+        grad_req="null").forward()[0].asnumpy()
+    assert_almost_equal(oh, np.eye(5, dtype=np.float32)[[0, 4, 2]],
+                        rtol=0, atol=0)
+
+
+def test_upsampling_backward():
+    data = sym.Variable("data")
+    net = sym.UpSampling(data, scale=2, sample_type="nearest", num_args=1)
+    d = RS(0).rand(1, 2, 3, 3).astype(np.float32)
+    check_numeric_gradient(net, {"data": d}, rtol=2e-2, atol=2e-3)
+
+
+def test_swapaxes_slice_backward():
+    data = sym.Variable("data")
+    d = RS(0).rand(2, 3, 4).astype(np.float32)
+    check_numeric_gradient(sym.SwapAxis(data, dim1=0, dim2=2), {"data": d},
+                           rtol=2e-2, atol=2e-3)
+    check_numeric_gradient(sym.slice_axis(data, axis=1, begin=1, end=3),
+                           {"data": d}, rtol=2e-2, atol=2e-3)
+
+
+def test_broadcast_binary_grad_reduces_over_broadcast_axes():
+    lhs, rhs = sym.Variable("lhs"), sym.Variable("rhs")
+    a = RS(0).rand(4, 3).astype(np.float32)
+    b = RS(1).rand(1, 3).astype(np.float32)
+    og = RS(2).rand(4, 3).astype(np.float32)
+    check_symbolic_backward(sym.broadcast_mul(lhs, rhs),
+                            {"lhs": a, "rhs": b}, [og],
+                            [og * b, (og * a).sum(axis=0, keepdims=True)])
+
+
+def test_ctc_loss_simple_case():
+    """CTCLoss vs a hand-computable single-label case (reference
+    contrib/ctc_loss parity: -log P(label) under the CTC alphas)."""
+    # vocab {blank=0, a=1}; T=2, label 'a' (length 1)
+    # paths emitting 'a': aa, a-, -a  -> P = p1a*p2a + p1a*p2b + p1b*p2a
+    probs = np.array([[[0.4, 0.6]], [[0.3, 0.7]]], np.float32)  # (T,B,V)
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    net = sym.CTCLoss(data, label)
+    # CTCLoss consumes pre-softmax activations in the reference; ours too —
+    # feed logits whose softmax equals `probs`
+    logits = np.log(probs)
+    lab = np.array([[1.0]], np.float32)
+    ex = net.bind(mx.cpu(), {"data": mx.nd.array(logits),
+                             "label": mx.nd.array(lab)}, grad_req="null")
+    loss = ex.forward()[0].asnumpy()
+    p = 0.6 * 0.7 + 0.6 * 0.3 + 0.4 * 0.7
+    assert_almost_equal(loss, np.array([-np.log(p)], np.float32),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_prior_geometry():
+    data = sym.Variable("data")
+    net = sym.MultiBoxPrior(data, sizes=(0.5,), ratios=(1.0,))
+    d = np.zeros((1, 3, 4, 4), np.float32)
+    out = net.bind(mx.cpu(), {"data": mx.nd.array(d)},
+                   grad_req="null").forward()[0].asnumpy()
+    assert out.shape == (1, 16, 4)
+    # first anchor centred on cell (0,0): center ~ (0.125, 0.125), size 0.5
+    cx = (out[0, 0, 0] + out[0, 0, 2]) / 2
+    cy = (out[0, 0, 1] + out[0, 0, 3]) / 2
+    assert cx == pytest.approx(0.125, abs=1e-5)
+    assert cy == pytest.approx(0.125, abs=1e-5)
+    assert out[0, 0, 2] - out[0, 0, 0] == pytest.approx(0.5, abs=1e-5)
+
+
+def test_where_grad_routing():
+    cond = sym.Variable("cond")
+    x, y = sym.Variable("x"), sym.Variable("y")
+    net = sym.where(cond, x, y)
+    c = np.array([1.0, 0.0, 1.0], np.float32)
+    a = RS(0).rand(3).astype(np.float32)
+    b = RS(1).rand(3).astype(np.float32)
+    og = np.array([10.0, 20.0, 30.0], np.float32)
+    ex = net.bind(mx.cpu(), {"cond": mx.nd.array(c), "x": mx.nd.array(a),
+                             "y": mx.nd.array(b)},
+                  args_grad={"x": mx.nd.zeros(3), "y": mx.nd.zeros(3)},
+                  grad_req={"cond": "null", "x": "write", "y": "write"})
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.array(og)])
+    assert_almost_equal(ex.grad_dict["x"].asnumpy(),
+                        og * (c != 0), rtol=1e-6, atol=1e-7)
+    assert_almost_equal(ex.grad_dict["y"].asnumpy(),
+                        og * (c == 0), rtol=1e-6, atol=1e-7)
+
+
+def test_div_power_grads_numeric():
+    lhs, rhs = sym.Variable("lhs"), sym.Variable("rhs")
+    a = RS(0).rand(3, 4).astype(np.float32) + 0.5
+    b = RS(1).rand(3, 4).astype(np.float32) + 0.5
+    check_numeric_gradient(sym._div(lhs, rhs), {"lhs": a, "rhs": b},
+                           rtol=2e-2, atol=2e-3)
+    check_numeric_gradient(sym._power(lhs, rhs), {"lhs": a, "rhs": b},
+                           rtol=3e-2, atol=3e-3)
+
+
+def test_leaky_relu_modes_grad():
+    data = sym.Variable("data")
+    d = (RS(0).rand(4, 5).astype(np.float32) - 0.5) * 2
+    for act in ("leaky", "elu"):
+        net = sym.LeakyReLU(data, act_type=act, slope=0.3)
+        check_numeric_gradient(net, {"data": d}, rtol=2e-2, atol=2e-3)
+    # prelu learns gamma
+    gamma = sym.Variable("gamma")
+    net = sym.LeakyReLU(data, gamma=gamma, act_type="prelu")
+    check_numeric_gradient(net, {"data": d,
+                                 "gamma": np.full(5, 0.25, np.float32)},
+                           rtol=2e-2, atol=2e-3)

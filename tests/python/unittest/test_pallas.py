@@ -73,6 +73,22 @@ def test_flash_pallas_backward_blocks(causal, bq, bk):
                                    rtol=3e-3, atol=3e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_pallas_backward_vs_xla_blocked(causal):
+    """The Pallas dq/dkv kernels against the blocked-XLA backward
+    (_flash_bwd_xla — kept exactly as the oracle for this test)."""
+    from mxnet_tpu.ops.pallas_kernels import (_flash_bwd, _flash_bwd_xla,
+                                              _flash_fwd)
+    q, k, v = _qkv(T=128, seed=9)
+    out, res = _flash_fwd(q, k, v, causal, None, 64, 64, True)
+    g = jnp.cos(out)
+    got = _flash_bwd(causal, None, 64, 64, True, res, g)
+    want = _flash_bwd_xla(causal, None, 64, 64, True, res, g)
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
 def test_flash_available_guard():
     assert flash_available((2, 2, 1024, 64))
     assert not flash_available((2, 2, 100, 64))    # T not block-divisible
