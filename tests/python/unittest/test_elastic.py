@@ -122,3 +122,42 @@ def test_launcher_restart_env():
     rc = launch.launch_local(2, [sys.executable, "-c", script],
                              max_restarts=2)
     assert rc == 0
+
+
+def test_health_check_generation_suffix(monkeypatch):
+    """A timed-out check's stale barrier must not be able to satisfy a LATER
+    check: every call uses a fresh process-local generation suffix (ADVICE r2
+    finding; the slow-but-alive hazard).  Also pins the collective-call
+    contract: same call count -> same name sequence."""
+    from mxnet_tpu.parallel import dist
+    seen = []
+
+    def fake_barrier(name):
+        seen.append(name)
+
+    monkeypatch.setattr(dist, "barrier", fake_barrier)
+    assert elastic.health_check(timeout=5.0)
+    assert elastic.health_check(timeout=5.0)
+    assert len(seen) == 2 and seen[0] != seen[1]
+    # a hung barrier (never returns) times out but burns its generation,
+    # so the NEXT check cannot pair with the stale pending one
+    import threading
+    release = threading.Event()
+
+    def hanging_barrier(name):
+        seen.append(name)
+        release.wait(30)
+
+    monkeypatch.setattr(dist, "barrier", hanging_barrier)
+    assert not elastic.health_check(timeout=0.2)
+    hung_name = seen[-1]
+    monkeypatch.setattr(dist, "barrier", fake_barrier)
+    assert elastic.health_check(timeout=5.0)
+    assert seen[-1] != hung_name
+    release.set()
+
+
+def test_num_dead_node_healthy_world():
+    """Single process: the world is trivially healthy (reference API shape
+    kvstore.h:242 — 0 means no dead nodes)."""
+    assert elastic.num_dead_node(timeout=5) == 0
