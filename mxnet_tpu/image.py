@@ -434,6 +434,8 @@ class ImageIter(mx_io.DataIter):
             if self.imgrec is not None:
                 s = self.imgrec.read_idx(idx)
                 header, img = recordio.unpack(s)
+                if recordio.is_raw_img(img):
+                    img = recordio.unpack_raw_img(img)
                 return header.label, img
             label, fname = self.imglist[idx]
             with open(os.path.join(self.path_root or "", fname), "rb") as f:
@@ -442,6 +444,8 @@ class ImageIter(mx_io.DataIter):
         if s is None:
             raise StopIteration
         header, img = recordio.unpack(s)
+        if recordio.is_raw_img(img):
+            img = recordio.unpack_raw_img(img)
         return header.label, img
 
     def next(self):
@@ -453,7 +457,12 @@ class ImageIter(mx_io.DataIter):
         try:
             while i < self.batch_size:
                 label, s = self.next_sample()
-                img = imdecode(s) if isinstance(s, (bytes, bytearray)) else s
+                if isinstance(s, (bytes, bytearray)):
+                    img = imdecode(s)
+                elif isinstance(s, np.ndarray):
+                    img = nd.array(s, dtype=np.uint8)  # pass-through record
+                else:
+                    img = s
                 for aug in self.auglist:
                     img = aug(img)
                 arr = img.asnumpy() if isinstance(img, nd.NDArray) \
@@ -551,7 +560,12 @@ class ImageRecordIter(mx_io.DataIter):
     def _augment_one(self, raw):
         """record bytes -> (C,H,W) float32, label vector."""
         header, img = recordio.unpack(raw)
-        arr = np.asarray(imdecode(img).asnumpy())
+        if recordio.is_raw_img(img):
+            # pass-through record (im2rec --pass-through): raw uint8 pixels,
+            # no JPEG decode — the decode-free path for host-bound loaders
+            arr = recordio.unpack_raw_img(img)
+        else:
+            arr = np.asarray(imdecode(img).asnumpy())
         c, h, w = self.data_shape
         if self.resize > 0:
             arr = resize_short(nd.array(arr, dtype=np.uint8),

@@ -18,7 +18,8 @@ import numpy as np
 from .base import MXNetError
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
-           "pack_img", "unpack_img"]
+           "pack_img", "unpack_img",
+           "pack_raw_img", "is_raw_img", "unpack_raw_img"]
 
 _KMAGIC = 0xCED7230A
 _LFLAG_BITS = 29
@@ -192,9 +193,52 @@ def pack_img(header, img, quality=95, img_fmt=".jpg"):
 
 
 def unpack_img(s, iscolor=-1):
-    """(parity: unpack_img)"""
-    import cv2
+    """(parity: unpack_img; also decodes pass-through raw records)"""
     header, s = unpack(s)
+    if is_raw_img(s):
+        return header, unpack_raw_img(s)
+    import cv2
     img = np.frombuffer(s, dtype=np.uint8)
     img = cv2.imdecode(img, iscolor)
     return header, img
+
+
+# --------------------------------------------------- raw (pass-through) images
+# A payload starting with RAW_IMG_MAGIC carries raw uint8 HWC pixels prefixed
+# by three little-endian uint16 dims — the decode-free path (parity: the
+# reference's ImageRecordUInt8Iter, iter_image_recordio.cc:481, packed with
+# im2rec --pass-through).  The marker lives in the payload, NOT header.flag,
+# because flag encodes the multi-label count (pack() above) — raw records
+# therefore compose with multi-label headers.  No encoded image format can
+# start with these bytes (JPEG: FF D8, PNG: 89 50, GIF: 47 49, BMP: 42 4D).
+RAW_IMG_MAGIC = b"MXRW"
+
+
+def pack_raw_img(header, img):
+    """Pack a (H, W, C) uint8 array without encoding (im2rec --pass-through).
+
+    Readers skip JPEG decode entirely — the 1-core-host loader bottleneck
+    documented in docs/perf.md."""
+    img = np.ascontiguousarray(img, dtype=np.uint8)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    h, w, c = img.shape
+    if h > 0xFFFF or w > 0xFFFF or c > 0xFFFF:
+        raise ValueError("pass-through records store uint16 dims; image "
+                         "%dx%dx%d exceeds 65535 (resize before packing)"
+                         % (h, w, c))
+    payload = RAW_IMG_MAGIC + struct.pack("<HHH", h, w, c) + img.tobytes()
+    return pack(header, payload)
+
+
+def is_raw_img(payload):
+    """True when a record payload is a pass-through raw image."""
+    return isinstance(payload, (bytes, bytearray)) and \
+        payload[:4] == RAW_IMG_MAGIC
+
+
+def unpack_raw_img(payload):
+    """Inverse of the pass-through payload: bytes -> (H, W, C) uint8."""
+    h, w, c = struct.unpack("<HHH", payload[4:10])
+    arr = np.frombuffer(payload, dtype=np.uint8, offset=10)
+    return arr.reshape(h, w, c)

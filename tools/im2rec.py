@@ -50,9 +50,11 @@ def make_list(prefix, root, recursive=True, train_ratio=1.0, shuffle=True,
     return len(paths)
 
 
-def pack(prefix, root, resize=0, quality=95, num_thread=1):
+def pack(prefix, root, resize=0, quality=95, num_thread=1,
+         pass_through=False):
     from mxnet_tpu import recordio
     from mxnet_tpu import image as mx_image
+    import numpy as np
 
     record = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
                                         "w")
@@ -67,11 +69,23 @@ def pack(prefix, root, resize=0, quality=95, num_thread=1):
             path = os.path.join(root, parts[-1])
             with open(path, "rb") as imgf:
                 buf = imgf.read()
+            label = labels[0] if len(labels) == 1 else labels
+            if pass_through:
+                # decode ONCE at pack time, store raw uint8 pixels: readers
+                # skip JPEG decode entirely (parity: the reference's uint8
+                # pass-through records, iter_image_recordio.cc:481)
+                img = mx_image.imdecode(buf)
+                if resize > 0:
+                    img = mx_image.resize_short(img, resize)
+                arr = np.asarray(img.asnumpy(), dtype=np.uint8)
+                header = recordio.IRHeader(0, label, idx, 0)
+                record.write_idx(idx, recordio.pack_raw_img(header, arr))
+                count += 1
+                continue
             if resize > 0:
                 img = mx_image.imdecode(buf)
                 img = mx_image.resize_short(img, resize)
                 buf = mx_image.imencode(img, quality=quality)
-            label = labels[0] if len(labels) == 1 else labels
             header = recordio.IRHeader(0, label, idx, 0)
             record.write_idx(idx, recordio.pack(header, buf))
             count += 1
@@ -90,13 +104,17 @@ def main():
     ap.add_argument("--no-shuffle", action="store_true")
     ap.add_argument("--resize", type=int, default=0)
     ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--pass-through", action="store_true",
+                    help="store raw uint8 pixels (decode once at pack time;"
+                         " readers skip JPEG decode)")
     args = ap.parse_args()
     if args.list:
         n = make_list(args.prefix, args.root, args.recursive,
                       args.train_ratio, not args.no_shuffle)
         print("wrote %d entries to %s.lst" % (n, args.prefix))
     else:
-        n = pack(args.prefix, args.root, args.resize, args.quality)
+        n = pack(args.prefix, args.root, args.resize, args.quality,
+                 pass_through=args.pass_through)
         print("packed %d records into %s.rec" % (n, args.prefix))
 
 
