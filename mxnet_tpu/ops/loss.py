@@ -25,6 +25,10 @@ def _softmax_out_infer(attrs, in_shapes):
         return in_shapes, [None], None
     if attrs.get("multi_output", False):
         label = (data[0],) + tuple(data[2:])
+    elif attrs.get("preserve_shape", False):
+        # softmax over the LAST axis, one label per leading position
+        # (reference softmax_output-inl.h preserve_shape)
+        label = tuple(data[:-1])
     else:
         label = (data[0],)
     return [data, label], [data], None
@@ -56,6 +60,10 @@ def _softmax_output_fn(grad_scale, ignore_label, multi_output, use_ignore,
         if multi_output:
             onehot = jnp.moveaxis(jax.nn.one_hot(lab, nclass, dtype=out.dtype),
                                   -1, 1)
+        elif preserve_shape:
+            # one label per leading position, classes on the LAST axis
+            onehot = jax.nn.one_hot(lab.reshape(out.shape[:-1]), nclass,
+                                    dtype=out.dtype)
         else:
             onehot = jax.nn.one_hot(lab.reshape(out.shape[0]), nclass,
                                     dtype=out.dtype).reshape(out.shape)
@@ -64,6 +72,8 @@ def _softmax_output_fn(grad_scale, ignore_label, multi_output, use_ignore,
             mask = (label != ignore_label).astype(out.dtype)
             if multi_output:
                 grad = grad * jnp.expand_dims(mask, 1)
+            elif preserve_shape:
+                grad = grad * mask.reshape(out.shape[:-1] + (1,))
             else:
                 grad = grad * mask.reshape((-1,) + (1,) * (out.ndim - 1))
         if normalization == "batch":

@@ -223,6 +223,20 @@ def _deconvolution(data, weight, bias=None, kernel=None, stride=(), dilate=(),
     stride = _tup(stride, nd, 1)
     pad_ = _tup(pad, nd, 0)
     adj_ = _tup(adj, nd, 0)
+    if target_shape:
+        # derive pad/adj so the output comes out exactly target-sized:
+        # o_pad = ceil(total/2), o_adj = total % 2 (reference
+        # deconvolution-inl.h InferPad — floor would shift content a pixel)
+        in_sp = data.shape[2:] if layout != "NHWC" else data.shape[1:-1]
+        totals = tuple((i - 1) * s + k - t
+                       for i, k, s, t in zip(in_sp, kernel, stride,
+                                             target_shape))
+        if any(t < 0 for t in totals):
+            raise MXNetError(
+                "Deconvolution target_shape %s is larger than the maximal "
+                "output for input %s" % (target_shape, tuple(in_sp)))
+        pad_ = tuple((t + 1) // 2 for t in totals)
+        adj_ = tuple(t % 2 for t in totals)
     # weight layout in MXNet deconv: (in_ch, out_ch/group, *kernel)
     w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
     if num_group > 1:
@@ -254,12 +268,18 @@ def _deconv_infer(attrs, in_shapes):
     stride = _tup(parse_tuple(attrs.get("stride", ())), nd, 1)
     pad = _tup(parse_tuple(attrs.get("pad", ())), nd, 0)
     adj = _tup(parse_tuple(attrs.get("adj", ())), nd, 0)
+    target = parse_tuple(attrs.get("target_shape", None) or ())
     ins = list(in_shapes)
     out = None
     if data is not None:
         ins[1] = (data[1], nf // ng) + kernel
-        spatial = tuple((i - 1) * s - 2 * p + k + a for i, k, s, p, a
-                        in zip(data[2:], kernel, stride, pad, adj))
+        if target:
+            # target_shape pins the output size; pad is derived from it
+            # (reference deconvolution-inl.h InferShape target_shape branch)
+            spatial = tuple(target)
+        else:
+            spatial = tuple((i - 1) * s - 2 * p + k + a for i, k, s, p, a
+                            in zip(data[2:], kernel, stride, pad, adj))
         out = (data[0], nf) + spatial
     if len(ins) > 2:
         ins[2] = (nf,)

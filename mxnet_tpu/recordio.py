@@ -238,7 +238,10 @@ def is_raw_img(payload):
 
 
 def unpack_raw_img(payload):
-    """Inverse of the pass-through payload: bytes -> (H, W, C) uint8."""
+    """Inverse of the pass-through payload: bytes -> (H, W, C) uint8.
+
+    Returns a writable array (same contract as the cv2.imdecode results
+    unpack_img produces for encoded records)."""
     h, w, c = struct.unpack("<HHH", payload[4:10])
     arr = np.frombuffer(payload, dtype=np.uint8, offset=10)
-    return arr.reshape(h, w, c)
+    return arr.reshape(h, w, c).copy()
