@@ -221,15 +221,22 @@ def _deconvolution(data, weight, bias=None, kernel=None, stride=(), dilate=(),
     the exact adjoint of `Convolution`, which XLA recognises and maps to MXU."""
     nd = len(kernel)
     stride = _tup(stride, nd, 1)
+    dilate_ = _tup(dilate, nd, 1)
     pad_ = _tup(pad, nd, 0)
     adj_ = _tup(adj, nd, 0)
+    # dilated ("effective") kernel extents drive all padding math
+    # (reference deconvolution-inl.h DilatedKernelSize)
+    keff = tuple((k - 1) * d + 1 for k, d in zip(kernel, dilate_))
     if target_shape:
+        if len(target_shape) != nd:
+            raise MXNetError("Deconvolution target_shape %s must have %d "
+                             "spatial dims" % (target_shape, nd))
         # derive pad/adj so the output comes out exactly target-sized:
         # o_pad = ceil(total/2), o_adj = total % 2 (reference
         # deconvolution-inl.h InferPad — floor would shift content a pixel)
         in_sp = data.shape[2:] if layout != "NHWC" else data.shape[1:-1]
         totals = tuple((i - 1) * s + k - t
-                       for i, k, s, t in zip(in_sp, kernel, stride,
+                       for i, k, s, t in zip(in_sp, keff, stride,
                                              target_shape))
         if any(t < 0 for t in totals):
             raise MXNetError(
@@ -249,10 +256,10 @@ def _deconvolution(data, weight, bias=None, kernel=None, stride=(), dilate=(),
     spatial = "DHW"[-nd:]
     dn = ("NC" + spatial, "OI" + spatial, "NC" + spatial)
     padding = [(k - 1 - p, k - 1 - p + a)
-               for k, p, a in zip(kernel, pad_, adj_)]
+               for k, p, a in zip(keff, pad_, adj_)]
     out = jax.lax.conv_general_dilated(
         data, w, window_strides=(1,) * nd, padding=padding,
-        lhs_dilation=stride, dimension_numbers=dn,
+        lhs_dilation=stride, rhs_dilation=dilate_, dimension_numbers=dn,
         feature_group_count=num_group)
     if bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
@@ -268,7 +275,12 @@ def _deconv_infer(attrs, in_shapes):
     stride = _tup(parse_tuple(attrs.get("stride", ())), nd, 1)
     pad = _tup(parse_tuple(attrs.get("pad", ())), nd, 0)
     adj = _tup(parse_tuple(attrs.get("adj", ())), nd, 0)
+    dilate = _tup(parse_tuple(attrs.get("dilate", ())), nd, 1)
+    keff = tuple((k - 1) * d + 1 for k, d in zip(kernel, dilate))
     target = parse_tuple(attrs.get("target_shape", None) or ())
+    if target and len(target) != nd:
+        raise MXNetError("Deconvolution target_shape %s must have %d "
+                         "spatial dims" % (target, nd))
     ins = list(in_shapes)
     out = None
     if data is not None:
@@ -276,10 +288,15 @@ def _deconv_infer(attrs, in_shapes):
         if target:
             # target_shape pins the output size; pad is derived from it
             # (reference deconvolution-inl.h InferShape target_shape branch)
+            if any((i - 1) * s + k - t < 0 for i, k, s, t
+                   in zip(data[2:], keff, stride, target)):
+                raise MXNetError(
+                    "Deconvolution target_shape %s is larger than the "
+                    "maximal output for input %s" % (target, data[2:]))
             spatial = tuple(target)
         else:
             spatial = tuple((i - 1) * s - 2 * p + k + a for i, k, s, p, a
-                            in zip(data[2:], kernel, stride, pad, adj))
+                            in zip(data[2:], keff, stride, pad, adj))
         out = (data[0], nf) + spatial
     if len(ins) > 2:
         ins[2] = (nf,)

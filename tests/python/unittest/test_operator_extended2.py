@@ -8,8 +8,7 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import sym
 from mxnet_tpu.test_utils import (assert_almost_equal,
-                                  check_numeric_gradient,
-                                  check_symbolic_forward)
+                                  check_numeric_gradient)
 
 RS = np.random.RandomState
 
@@ -201,7 +200,8 @@ def test_rnn_op_matches_manual_recurrence():
 # -------------------------------------------------------- remaining backward
 def test_pooling_full_convention_output():
     """'full' convention uses ceil for the output size (reference
-    pooling-inl.h); a 5x5 input with k=3 s=2 gives 2 (valid) vs 3 (full)."""
+    pooling-inl.h); a 6x6 input with k=3 s=2 gives 2 (valid, floor) vs
+    3 (full, ceil)."""
     data = sym.Variable("data")
     d = RS(0).rand(1, 1, 6, 6).astype(np.float32)
     for conv, expect in (("valid", 2), ("full", 3)):
@@ -332,3 +332,25 @@ def test_instance_norm_numeric_gradient():
                                  "gamma": np.ones(3, np.float32),
                                  "beta": RS(1).rand(3).astype(np.float32)},
                            rtol=3e-2, atol=3e-3)
+
+
+def test_deconv_dilate_and_target_shape_validation():
+    """Dilated deconvolution gradient + target_shape error paths (review
+    findings: dilate was silently dropped; bad targets must fail at
+    shape-inference time)."""
+    data = sym.Variable("data")
+    net = sym.Deconvolution(data, kernel=(3, 3), stride=(1, 1),
+                            dilate=(2, 2), num_filter=2, name="dc")
+    # effective kernel 5: output (i-1)*s + keff = 4 + 5 = 8
+    _, out_shapes, _ = net.infer_shape(data=(1, 2, 4, 4))
+    assert tuple(out_shapes[0]) == (1, 2, 8, 8)
+    d = RS(0).rand(1, 2, 4, 4).astype(np.float32)
+    w = RS(1).rand(2, 2, 3, 3).astype(np.float32)
+    check_numeric_gradient(net, {"data": d, "dc_weight": w}, rtol=2e-2,
+                           atol=2e-3)
+    # wrong target rank and impossible target both fail at infer time
+    for bad in ({"target_shape": (8,)}, {"target_shape": (100, 100)}):
+        netb = sym.Deconvolution(data, kernel=(3, 3), stride=(2, 2),
+                                 num_filter=2, name="dc", **bad)
+        with pytest.raises(Exception):
+            netb.infer_shape(data=(1, 2, 4, 4))

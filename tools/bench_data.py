@@ -23,8 +23,10 @@ import numpy as np
 sys.path.insert(0, ".")
 
 
-def build_dataset(rec_path, num_images, size=256, quality=85):
-    """Pack synthetic JPEGs (random textured patches) into RecordIO."""
+def build_dataset(rec_path, num_images, size=256, quality=85,
+                  pass_through=False):
+    """Pack synthetic images into RecordIO (JPEG, or raw pass-through
+    records that skip decode at read time — im2rec --pass-through)."""
     from PIL import Image
     from mxnet_tpu import recordio
     rec = recordio.MXRecordIO(rec_path, "w")
@@ -34,10 +36,13 @@ def build_dataset(rec_path, num_images, size=256, quality=85):
         # cheap variety without re-randomising every pixel
         img = np.roll(base, shift=int(rng.randint(0, size)), axis=0)
         img = np.roll(img, shift=int(rng.randint(0, size)), axis=1)
-        buf = io.BytesIO()
-        Image.fromarray(img).save(buf, format="JPEG", quality=quality)
         header = recordio.IRHeader(0, float(i % 1000), i, 0)
-        rec.write(recordio.pack(header, buf.getvalue()))
+        if pass_through:
+            rec.write(recordio.pack_raw_img(header, img))
+        else:
+            buf = io.BytesIO()
+            Image.fromarray(img).save(buf, format="JPEG", quality=quality)
+            rec.write(recordio.pack(header, buf.getvalue()))
     rec.close()
 
 
@@ -113,14 +118,18 @@ def main():
     ap.add_argument("--images", type=int, default=1536)
     ap.add_argument("--threads", type=int, default=8)
     ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--pass-through", action="store_true",
+                    help="raw records (no JPEG decode at read time)")
     args = ap.parse_args()
     with tempfile.TemporaryDirectory() as td:
         rec = os.path.join(td, "data.rec")
         t0 = time.perf_counter()
-        build_dataset(rec, args.images)
+        build_dataset(rec, args.images, pass_through=args.pass_through)
         pack_s = time.perf_counter() - t0
         loader = bench_loader(rec, args.batch, args.threads)
-        print(json.dumps({"metric": "imagerecorditer_img_per_sec",
+        print(json.dumps({"metric": "imagerecorditer_img_per_sec"
+                                    + ("_pass_through" if args.pass_through
+                                       else ""),
                           "value": round(loader, 1), "unit": "img/s",
                           "threads": args.threads,
                           "pack_seconds": round(pack_s, 1)}), flush=True)
