@@ -146,6 +146,9 @@ MXNET_DLL int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
 MXNET_DLL int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out);
 MXNET_DLL int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index,
                                 SymbolHandle *out);
+/*! \brief deprecated in the reference too: use bind + backward */
+MXNET_DLL int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt,
+                           const char **wrt, SymbolHandle *out);
 /*! \brief bidirectional dtype inference; *complete==0 when underspecified */
 MXNET_DLL int MXSymbolInferType(SymbolHandle sym, mx_uint num_args,
                                 const char **keys, const int *arg_type_data,
@@ -167,6 +170,16 @@ MXNET_DLL int MXSymbolInferShape(
     const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
     mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
     const mx_uint ***aux_shape_data, int *complete);
+/*! \brief like MXSymbolInferShape but tolerates underspecified graphs:
+ *  unknown entries come back 0-dimensional (reference c_api.h partial) */
+MXNET_DLL int MXSymbolInferShapePartial(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete);
 
 /* -------------------------------------------------------------- Executor */
 /*! \brief bind a symbol into an executor (parity: MXExecutorBindEX,
@@ -180,6 +193,33 @@ MXNET_DLL int MXExecutorBind(SymbolHandle symbol_handle, int dev_type,
                              NDArrayHandle *arg_grad_store,
                              mx_uint *grad_req_type, mx_uint aux_states_len,
                              NDArrayHandle *aux_states, ExecutorHandle *out);
+/*! \brief reference signature with group2ctx maps (c_api.h:1004); maps must
+ *  be empty over the C boundary — bind model-parallel graphs from Python */
+MXNET_DLL int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type,
+                              int dev_id, mx_uint num_map_keys,
+                              const char **map_keys,
+                              const int *map_dev_types,
+                              const int *map_dev_ids, mx_uint len,
+                              NDArrayHandle *in_args,
+                              NDArrayHandle *arg_grad_store,
+                              mx_uint *grad_req_type, mx_uint aux_states_len,
+                              NDArrayHandle *aux_states,
+                              ExecutorHandle *out);
+/*! \brief BindX + shared_exec memory sharing (c_api.h:1040); shared_exec
+ *  must be NULL here (XLA owns buffers — bucketing shares via the jit
+ *  cache instead) */
+MXNET_DLL int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type,
+                               int dev_id, mx_uint num_map_keys,
+                               const char **map_keys,
+                               const int *map_dev_types,
+                               const int *map_dev_ids, mx_uint len,
+                               NDArrayHandle *in_args,
+                               NDArrayHandle *arg_grad_store,
+                               mx_uint *grad_req_type,
+                               mx_uint aux_states_len,
+                               NDArrayHandle *aux_states,
+                               ExecutorHandle shared_exec,
+                               ExecutorHandle *out);
 MXNET_DLL int MXExecutorFree(ExecutorHandle handle);
 MXNET_DLL int MXExecutorForward(ExecutorHandle handle, int is_train);
 /*! \brief run the backward pass; head_grads may be NULL/len 0 for loss ops */

@@ -107,6 +107,22 @@ def symbol_infer_shape(handle, names, shapes):
             tuple(map(tuple, aux_shapes)))
 
 
+def symbol_infer_shape_partial(handle, names, shapes):
+    """Partial inference: unknown shapes come back as (), and the trailing
+    flag reports whether everything resolved (parity:
+    MXSymbolInferShapePartial's *complete)."""
+    kwargs = {n: tuple(s) for n, s in zip(names, shapes)}
+    arg_shapes, out_shapes, aux_shapes = \
+        _sym(handle).infer_shape_partial(**kwargs)
+
+    def norm(shapes_):
+        return tuple(() if s is None else tuple(s) for s in (shapes_ or ()))
+    groups = (norm(arg_shapes), norm(out_shapes), norm(aux_shapes))
+    complete = int(all(len(s) > 0 for g in groups for s in g)
+                   and arg_shapes is not None)
+    return groups + (complete,)
+
+
 # ---------------------------------------------------------------- predictor
 def pred_create(symbol_json, param_bytes, dev_type, dev_id, input_names,
                 input_shapes):

@@ -897,15 +897,19 @@ int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char **keys,
   API_END();
 }
 
-int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
-                       const mx_uint *arg_ind_ptr,
-                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
-                       const mx_uint **in_shape_ndim,
-                       const mx_uint ***in_shape_data,
-                       mx_uint *out_shape_size, const mx_uint **out_shape_ndim,
-                       const mx_uint ***out_shape_data,
-                       mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
-                       const mx_uint ***aux_shape_data, int *complete) {
+static int InferShapeImpl(const char *shim, SymbolHandle sym,
+                          mx_uint num_args, const char **keys,
+                          const mx_uint *arg_ind_ptr,
+                          const mx_uint *arg_shape_data,
+                          mx_uint *in_shape_size,
+                          const mx_uint **in_shape_ndim,
+                          const mx_uint ***in_shape_data,
+                          mx_uint *out_shape_size,
+                          const mx_uint **out_shape_ndim,
+                          const mx_uint ***out_shape_data,
+                          mx_uint *aux_shape_size,
+                          const mx_uint **aux_shape_ndim,
+                          const mx_uint ***aux_shape_data, int *complete) {
   API_BEGIN();
   PyObject *names = StrList(num_args, keys);
   PyObject *shapes = PyList_New(num_args);
@@ -919,7 +923,7 @@ int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
   }
   PyObject *args = Py_BuildValue("(ONN)", reinterpret_cast<PyObject *>(sym),
                                  names, shapes);
-  PyObject *r = CallShim("symbol_infer_shape", args);
+  PyObject *r = CallShim(shim, args);
   Py_DECREF(args);
   CHECK_PY(r);
   if (r == Py_None) {
@@ -955,9 +959,54 @@ int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
        out_shape_ndim, out_shape_data);
   fill(PyTuple_GetItem(r, 2), &scratch.shapes_aux, aux_shape_size,
        aux_shape_ndim, aux_shape_data);
-  *complete = 1;
+  /* the partial shim appends an explicit resolved-flag; the full shim
+   * signalled incompleteness with None above */
+  *complete = (PyTuple_Size(r) > 3)
+      ? static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 3))) : 1;
   Py_DECREF(r);
   API_END();
+}
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
+                       const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size, const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete) {
+  return InferShapeImpl("symbol_infer_shape", sym, num_args, keys,
+                        arg_ind_ptr, arg_shape_data, in_shape_size,
+                        in_shape_ndim, in_shape_data, out_shape_size,
+                        out_shape_ndim, out_shape_data, aux_shape_size,
+                        aux_shape_ndim, aux_shape_data, complete);
+}
+
+int MXSymbolInferShapePartial(
+    SymbolHandle sym, mx_uint num_args, const char **keys,
+    const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+    mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+    const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+    const mx_uint **out_shape_ndim, const mx_uint ***out_shape_data,
+    mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+    const mx_uint ***aux_shape_data, int *complete) {
+  return InferShapeImpl("symbol_infer_shape_partial", sym, num_args, keys,
+                        arg_ind_ptr, arg_shape_data, in_shape_size,
+                        in_shape_ndim, in_shape_data, out_shape_size,
+                        out_shape_ndim, out_shape_data, aux_shape_size,
+                        aux_shape_ndim, aux_shape_data, complete);
+}
+
+int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt, const char **wrt,
+                 SymbolHandle *out) {
+  (void)sym;
+  (void)num_wrt;
+  (void)wrt;
+  (void)out;
+  last_error = "MXSymbolGrad is deprecated (reference parity): bind an "
+               "executor and call MXExecutorBackward";
+  return -1;
 }
 
 /* ---------------------------------------------------------------- Executor */
@@ -980,6 +1029,45 @@ int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
   CHECK_PY(r);
   *out = r;
   API_END();
+}
+
+int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out) {
+  (void)map_keys;
+  (void)map_dev_types;
+  (void)map_dev_ids;
+  if (num_map_keys != 0) {
+    last_error = "MXExecutorBindX: group2ctx maps are not supported over "
+                 "the C boundary; bind model-parallel graphs from Python";
+    return -1;
+  }
+  return MXExecutorBind(symbol_handle, dev_type, dev_id, len, in_args,
+                        arg_grad_store, grad_req_type, aux_states_len,
+                        aux_states, out);
+}
+
+int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                     mx_uint num_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle *out) {
+  if (shared_exec != nullptr) {
+    last_error = "MXExecutorBindEX: shared_exec memory sharing is owned by "
+                 "XLA here (bucketing shares compiled programs via the jit "
+                 "cache); pass NULL";
+    return -1;
+  }
+  return MXExecutorBindX(symbol_handle, dev_type, dev_id, num_map_keys,
+                         map_keys, map_dev_types, map_dev_ids, len, in_args,
+                         arg_grad_store, grad_req_type, aux_states_len,
+                         aux_states, out);
 }
 
 int MXExecutorFree(ExecutorHandle handle) {

@@ -524,3 +524,69 @@ def test_multithreaded_imperative_invoke(libmx):
     for t in threads:
         t.join()
     assert not errors, errors
+
+
+def test_bind_variants_and_infer_partial(libmx):
+    """MXExecutorBindX/BindEX name parity + MXSymbolInferShapePartial
+    (underspecified graphs return 0-dim entries with complete=0 semantics
+    preserved via empty shapes)."""
+    lib = libmx
+    x = _variable(lib, "data")
+    fc = _compose(lib, _atomic(lib, "FullyConnected",
+                               ("num_hidden",), ("4",)), "pfc", data=x)
+    # partial inference with NO known shapes: weight/bias unknown -> ()
+    in_size = ctypes.c_uint(); in_ndim = c_uint_p()
+    in_data = ctypes.POINTER(c_uint_p)()
+    out_size = ctypes.c_uint(); out_ndim = c_uint_p()
+    out_data = ctypes.POINTER(c_uint_p)()
+    aux_size = ctypes.c_uint(); aux_ndim = c_uint_p()
+    aux_data = ctypes.POINTER(c_uint_p)()
+    complete = ctypes.c_int()
+    ind_ptr = (ctypes.c_uint * 1)(0)
+    _check(lib, lib.MXSymbolInferShapePartial(
+        fc, 0, None, ind_ptr, None,
+        ctypes.byref(in_size), ctypes.byref(in_ndim), ctypes.byref(in_data),
+        ctypes.byref(out_size), ctypes.byref(out_ndim),
+        ctypes.byref(out_data), ctypes.byref(aux_size),
+        ctypes.byref(aux_ndim), ctypes.byref(aux_data),
+        ctypes.byref(complete)))
+    assert in_size.value == 3            # data, weight, bias
+    assert in_ndim[0] == 0               # unknown -> 0-dim
+    assert complete.value == 0           # underspecified graph
+
+    # BindX with empty maps == Bind; with maps -> clean error
+    batch = 2
+    shapes = [(batch, 6), (4, 6), (4,)]
+    args = [_nd_create(lib, s) for s in shapes]
+    for h, s in zip(args, shapes):
+        _nd_set(lib, h, np.zeros(s))
+    arg_arr = (Handle * 3)(*args)
+    grads = (Handle * 3)(None, None, None)
+    reqs = (ctypes.c_uint * 3)(0, 0, 0)
+    ex = Handle()
+    _check(lib, lib.MXExecutorBindX(fc, 1, 0, 0, None, None, None,
+                                    3, arg_arr, grads, reqs, 0, None,
+                                    ctypes.byref(ex)))
+    _check(lib, lib.MXExecutorForward(ex, 0))
+    n_out = ctypes.c_uint(); outs = ctypes.POINTER(Handle)()
+    _check(lib, lib.MXExecutorOutputs(ex, ctypes.byref(n_out),
+                                      ctypes.byref(outs)))
+    assert n_out.value == 1
+    _check(lib, lib.MXNDArrayFree(Handle(outs[0])))
+    _check(lib, lib.MXExecutorFree(ex))
+    keys = _strs("group1")
+    dts = (ctypes.c_int * 1)(1)
+    ids = (ctypes.c_int * 1)(0)
+    assert lib.MXExecutorBindX(fc, 1, 0, 1, keys, dts, ids, 3, arg_arr,
+                               grads, reqs, 0, None, ctypes.byref(ex)) == -1
+    assert b"group2ctx" in lib.MXGetLastError()
+    # BindEX rejects shared_exec
+    assert lib.MXExecutorBindEX(fc, 1, 0, 0, None, None, None, 3, arg_arr,
+                                grads, reqs, 0, None, Handle(1234),
+                                ctypes.byref(ex)) == -1
+    # MXSymbolGrad: deprecated, parity with symbol.grad
+    g = Handle()
+    assert lib.MXSymbolGrad(fc, 1, _strs("data"), ctypes.byref(g)) == -1
+    assert b"deprecated" in lib.MXGetLastError()
+    for h in args:
+        _check(lib, lib.MXNDArrayFree(h))
