@@ -118,8 +118,14 @@ def symbol_infer_shape_partial(handle, names, shapes):
     def norm(shapes_):
         return tuple(() if s is None else tuple(s) for s in (shapes_ or ()))
     groups = (norm(arg_shapes), norm(out_shapes), norm(aux_shapes))
-    complete = int(all(len(s) > 0 for g in groups for s in g)
-                   and arg_shapes is not None)
+    # resolvedness is judged on the raw shapes, BEFORE the ()-normalisation
+    # for the wire format: a legitimate 0-dim scalar shape is resolved;
+    # unresolved is None or a shape still containing MXNet's 0-valued
+    # unknown-dim wildcard (the convention symbol.py's inference uses)
+    complete = int(arg_shapes is not None and all(
+        s is not None and 0 not in tuple(s)
+        for g in (arg_shapes, out_shapes, aux_shapes)
+        for s in (g or ())))
     return groups + (complete,)
 
 

@@ -548,22 +548,27 @@ class _FusedFit(object):
         aux = {n: nd.NDArray(v) for n, v in aux_cp.items()}
         mod._exec_group.set_params(arg, aux)
         if mod._arg_params is not None:
-            # ONE device->host transfer: concatenate on device, split on host
-            # (jax.device_get fetches leaf by leaf — a round trip each on a
-            # tunneled TPU)
+            # Batched device->host transfer: concatenate on device, split on
+            # host (jax.device_get fetches leaf by leaf — a round trip each on
+            # a tunneled TPU). One concat PER DTYPE: casting everything through
+            # f32 would silently truncate f64 or integer params/aux.
             items = [("arg", n, v) for n, v in sorted(self._params.items())] \
                 + [("aux", n, v) for n, v in sorted(self._aux.items())]
-            flat = _np.asarray(jnp.concatenate(
-                [v.reshape(-1).astype(jnp.float32) for _, _, v in items]))
-            ofs = 0
-            for kind, n, v in items:
-                size = 1
-                for d in v.shape:
-                    size *= d
-                chunk = flat[ofs:ofs + size].reshape(v.shape)
-                ofs += size
-                dst = mod._arg_params if kind == "arg" else mod._aux_params
-                dst[n][:] = chunk
+            by_dtype = {}
+            for it in items:
+                by_dtype.setdefault(jnp.dtype(it[2].dtype), []).append(it)
+            for dt, group in by_dtype.items():
+                flat = _np.asarray(jnp.concatenate(
+                    [v.reshape(-1) for _, _, v in group]))
+                ofs = 0
+                for kind, n, v in group:
+                    size = 1
+                    for d in v.shape:
+                        size *= d
+                    chunk = flat[ofs:ofs + size].reshape(v.shape)
+                    ofs += size
+                    dst = mod._arg_params if kind == "arg" else mod._aux_params
+                    dst[n][:] = chunk
         mod._params_dirty = False
         mod._active_fused = None
         # an explicit kvstore holds its own stored weights (pull sources) —

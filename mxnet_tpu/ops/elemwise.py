@@ -60,6 +60,9 @@ _UNARY = {
     "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
     "gamma": _gamma,
     "gammaln": lambda x: jax.scipy.special.gammaln(x),
+    # parity: elemwise_unary_op.cc:377-399 (degrees/radians)
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
 }
 
 for _name, _f in _UNARY.items():

@@ -157,6 +157,19 @@ int StrListOut(PyObject *list, mx_uint *out_size, const char ***out_array) {
                          &scratch.cstrs);
 }
 
+/* Copy one python unicode object into *dst.  A non-string (or
+ * non-UTF8-encodable) object yields the clean -1 error path instead of
+ * constructing a std::string from nullptr (UB). */
+int StrOut(PyObject *s, std::string *dst) {
+  const char *c = (s == nullptr) ? nullptr : PyUnicode_AsUTF8(s);
+  if (c == nullptr) {
+    last_error = FetchPyError();
+    return -1;
+  }
+  dst->assign(c);
+  return 0;
+}
+
 /* Python list from NDArrayHandle array; NULL entries become None. */
 PyObject *NDList(mx_uint n, NDArrayHandle *h) {
   PyObject *l = PyList_New(n);
@@ -467,8 +480,9 @@ int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json) {
   PyObject *r = CallShim("symbol_save_to_json", args);
   Py_DECREF(args);
   CHECK_PY(r);
-  scratch.json = PyUnicode_AsUTF8(r);
+  int rc = StrOut(r, &scratch.json);
   Py_DECREF(r);
+  if (rc != 0) return -1;
   *out_json = scratch.json.c_str();
   API_END();
 }
@@ -670,9 +684,12 @@ int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
   Py_DECREF(args);
   CHECK_PY(r);
   static thread_local std::string nm, doc, kv;
-  nm = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
-  doc = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
-  kv = PyUnicode_AsUTF8(PyTuple_GetItem(r, 5));
+  if (StrOut(PyTuple_GetItem(r, 0), &nm) != 0 ||
+      StrOut(PyTuple_GetItem(r, 1), &doc) != 0 ||
+      StrOut(PyTuple_GetItem(r, 5), &kv) != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
   mx_uint n1 = 0, n2 = 0, n3 = 0;
   if (StrListOut(PyTuple_GetItem(r, 2), &n1, arg_names) != 0 ||
       StrListOutArena(PyTuple_GetItem(r, 3), &n2, arg_type_infos,
@@ -784,8 +801,9 @@ int MXSymbolPrint(SymbolHandle symbol, const char **out_str) {
   PyObject *r = CallShim("symbol_print", args);
   Py_DECREF(args);
   CHECK_PY(r);
-  scratch.json = PyUnicode_AsUTF8(r);
+  int rc = StrOut(r, &scratch.json);
   Py_DECREF(r);
+  if (rc != 0) return -1;
   *out_str = scratch.json.c_str();
   API_END();
 }
@@ -802,7 +820,10 @@ int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
     *success = 0;
     *out = nullptr;
   } else {
-    scratch.json = PyUnicode_AsUTF8(r);
+    if (StrOut(r, &scratch.json) != 0) {
+      Py_DECREF(r);
+      return -1;
+    }
     *out = scratch.json.c_str();
     *success = 1;
   }
@@ -1117,8 +1138,9 @@ int MXExecutorPrint(ExecutorHandle handle, const char **out_str) {
   PyObject *r = CallShim("executor_print", args);
   Py_DECREF(args);
   CHECK_PY(r);
-  scratch.json = PyUnicode_AsUTF8(r);
+  int rc = StrOut(r, &scratch.json);
   Py_DECREF(r);
+  if (rc != 0) return -1;
   *out_str = scratch.json.c_str();
   API_END();
 }
@@ -1215,8 +1237,9 @@ int MXKVStoreGetType(KVStoreHandle handle, const char **type) {
   PyObject *r = CallShim("kvstore_get_type", args);
   Py_DECREF(args);
   CHECK_PY(r);
-  scratch.json = PyUnicode_AsUTF8(r);
+  int rc = StrOut(r, &scratch.json);
   Py_DECREF(r);
+  if (rc != 0) return -1;
   *type = scratch.json.c_str();
   API_END();
 }
@@ -1333,8 +1356,11 @@ int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
   Py_DECREF(args);
   CHECK_PY(r);
   static thread_local std::string nm, doc;
-  nm = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
-  doc = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  if (StrOut(PyTuple_GetItem(r, 0), &nm) != 0 ||
+      StrOut(PyTuple_GetItem(r, 1), &doc) != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
   Py_DECREF(r);
   *name = nm.c_str();
   *description = doc.c_str();
