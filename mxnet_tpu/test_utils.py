@@ -119,6 +119,15 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
     """Finite differences vs autodiff gradients (parity:
     test_utils.check_numeric_gradient:359)."""
     ctx = ctx or default_context()
+    # non-loss heads: make the implicit all-ones head gradient explicit by
+    # wrapping in MakeLoss (identity forward, ones backward — the reference
+    # test_utils.py:359 wraps the same way), so backward() never needs the
+    # implicit-head-grad fallback (and never warns about it)
+    head = sym._outputs[0][0]
+    if not head.is_var and not getattr(head.op, "is_loss", False) \
+            and head.op.name != "BlockGrad":
+        from . import symbol as _sym_mod
+        sym = _sym_mod.create("MakeLoss", data=sym)
     location = _parse_location(sym, location, ctx)
     location_npy = {k: v.asnumpy() for k, v in location.items()}
     if grad_nodes is None:
