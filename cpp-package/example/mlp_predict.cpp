@@ -63,5 +63,24 @@ int main(int argc, char **argv) {
     }
     printf("row %u argmax %u\n", r, best);
   }
+
+  /* feature extraction: bind the SAME model up to its first hidden layer
+   * (MXPredCreatePartialOut) and read the activations */
+  Predictor feat(symbol_json, params, Context::cpu(),
+                 {{"data", {batch, dim}}}, {"fc1"});
+  feat.SetInput("data", data);
+  int step = 0;
+  while (feat.PartialForward(++step) > 0) {
+  }
+  auto fshape = feat.GetOutputShape(0);
+  auto fout = feat.GetOutput(0);
+  double l2 = 0.0;
+  for (float v : fout) l2 += static_cast<double>(v) * v;
+  printf("feature shape: (%u, %u) l2 %.4f\n", fshape[0], fshape[1], l2);
+  if (fshape[0] != batch || l2 <= 0.0) {
+    fprintf(stderr, "feature extraction failed\n");
+    return 1;
+  }
+  printf("FEATURES OK\n");
   return 0;
 }

@@ -538,10 +538,39 @@ class Predictor {
                        static_cast<mx_uint>(inputs.size()), keys.data(),
                        indptr.data(), shapes.data(), &handle_));
   }
+  /*! feature-extraction constructor: bind up to named internal outputs
+   *  (parity: reference MXPredCreatePartialOut usage) */
+  Predictor(const std::string &symbol_json, const std::string &param_bytes,
+            const Context &ctx,
+            const std::vector<std::pair<std::string,
+                                        std::vector<mx_uint>>> &inputs,
+            const std::vector<std::string> &output_keys) {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> shapes;
+    for (auto &kv : inputs) {
+      keys.push_back(kv.first.c_str());
+      for (mx_uint d : kv.second) shapes.push_back(d);
+      indptr.push_back(static_cast<mx_uint>(shapes.size()));
+    }
+    std::vector<const char *> outs;
+    for (auto &k : output_keys) outs.push_back(k.c_str());
+    Check(MXPredCreatePartialOut(
+        symbol_json.c_str(), param_bytes.data(),
+        static_cast<int>(param_bytes.size()), ctx.dev_type(), ctx.dev_id(),
+        static_cast<mx_uint>(inputs.size()), keys.data(), indptr.data(),
+        shapes.data(), static_cast<mx_uint>(outs.size()), outs.data(),
+        &handle_));
+  }
   Predictor(const Predictor &) = delete;
   Predictor &operator=(const Predictor &) = delete;
   ~Predictor() {
     if (handle_ != nullptr) MXPredFree(handle_);
+  }
+  int PartialForward(int step) {
+    int left = 0;
+    Check(MXPredPartialForward(handle_, step, &left));
+    return left;
   }
 
   void SetInput(const std::string &key, const std::vector<mx_float> &data) {

@@ -39,6 +39,59 @@ typedef void *DataIterCreator;
 typedef void (*MXKVStoreUpdater)(int key, NDArrayHandle recv,
                                  NDArrayHandle local, void *handle);
 
+/*! \brief per-op monitor callback (parity: reference c_api.h:68
+ *  ExecutorMonitorCallback).  Receives the op-output name and an OWNED
+ *  NDArray handle the callback must free with MXNDArrayFree. */
+typedef void (*ExecutorMonitorCallback)(const char *name,
+                                        NDArrayHandle arr, void *handle);
+
+/*! \brief C custom-operator callback tables (parity: reference
+ *  c_api.h:103-140 CustomOpInfo/CustomOpPropInfo/CustomOpPropCreator;
+ *  tags: 0 in_data, 1 out_data, 2 in_grad, 3 out_grad, 4 aux). */
+struct CustomOpInfo {
+  bool (*forward)(int /*size*/, void ** /*ptrs*/, int * /*tags*/,
+                  const int * /*reqs*/, const bool /*is_train*/,
+                  void * /*state*/);
+  bool (*backward)(int /*size*/, void ** /*ptrs*/, int * /*tags*/,
+                   const int * /*reqs*/, const bool /*is_train*/,
+                   void * /*state*/);
+  bool (*del)(void * /*state*/);
+  void *p_forward;
+  void *p_backward;
+  void *p_del;
+};
+
+struct CustomOpPropInfo {
+  bool (*list_arguments)(char *** /*args*/, void * /*state*/);
+  bool (*list_outputs)(char *** /*outputs*/, void * /*state*/);
+  bool (*infer_shape)(int /*num_input*/, int * /*ndims*/,
+                      unsigned ** /*shapes*/, void * /*state*/);
+  bool (*declare_backward_dependency)(const int * /*out_grad*/,
+                                      const int * /*in_data*/,
+                                      const int * /*out_data*/,
+                                      int * /*num_deps*/, int ** /*rdeps*/,
+                                      void * /*state*/);
+  bool (*create_operator)(const char * /*ctx*/, int /*num_inputs*/,
+                          unsigned ** /*shapes*/, int * /*ndims*/,
+                          int * /*dtypes*/, struct CustomOpInfo * /*ret*/,
+                          void * /*state*/);
+  bool (*list_auxiliary_states)(char *** /*aux*/, void * /*state*/);
+  bool (*del)(void * /*state*/);
+  void *p_list_arguments;
+  void *p_list_outputs;
+  void *p_infer_shape;
+  void *p_declare_backward_dependency;
+  void *p_create_operator;
+  void *p_list_auxiliary_states;
+  void *p_del;
+};
+
+typedef bool (*CustomOpPropCreator)(const char * /*op_type*/,
+                                    const int /*num_kwargs*/,
+                                    const char ** /*keys*/,
+                                    const char ** /*values*/,
+                                    struct CustomOpPropInfo * /*ret*/);
+
 /*! \brief return the last error message on this thread */
 MXNET_DLL const char *MXGetLastError();
 
@@ -66,6 +119,21 @@ MXNET_DLL int MXNDArrayLoad(const char *fname, mx_uint *out_size,
                             NDArrayHandle **out_arr, mx_uint *out_name_size,
                             const char ***out_names);
 MXNET_DLL int MXNDArrayWaitAll();
+/*! \brief block until the array's pending computation is done (parity:
+ *  c_api.h:319-326; one sync covers both directions on functional arrays) */
+MXNET_DLL int MXNDArrayWaitToRead(NDArrayHandle handle);
+MXNET_DLL int MXNDArrayWaitToWrite(NDArrayHandle handle);
+/*! \brief single-array serialization primitive (parity: c_api.h:246-270,
+ *  the format under kvstore state transfer).  The returned buffer is valid
+ *  until the next call on this thread. */
+MXNET_DLL int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                                    const char **out_buf);
+MXNET_DLL int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                                        NDArrayHandle *out);
+/*! \brief host float32 view of the data (parity: c_api.h:389).  The
+ *  pointer stays valid while the handle lives; XLA arrays are immutable so
+ *  the view is read-only (the reference's CPU pointer is mutable). */
+MXNET_DLL int MXNDArrayGetData(NDArrayHandle handle, mx_float **out_pdata);
 /*! \brief create with explicit dtype (0=f32 1=f64 2=f16 3=u8 4=i32 5=i8 6=i64) */
 MXNET_DLL int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim,
                                 int dev_type, int dev_id, int delay_alloc,
@@ -141,6 +209,18 @@ MXNET_DLL int MXSymbolGetAttr(SymbolHandle symbol, const char *key,
 MXNET_DLL int MXSymbolSetAttr(SymbolHandle symbol, const char *key,
                               const char *value);
 /*! \brief flat [k0,v0,k1,v1,...] attribute list, keys "node$attr" */
+/*! \brief out-node name; *success=0 for unnamed groups (parity:
+ *  c_api.h:658) */
+MXNET_DLL int MXSymbolGetName(SymbolHandle symbol, const char **out,
+                              int *success);
+/*! \brief group of the out nodes' direct inputs (parity: c_api.h:746) */
+MXNET_DLL int MXSymbolGetChildren(SymbolHandle symbol, SymbolHandle *out);
+/*! \brief write the graph JSON to a file (parity: c_api.h:623) */
+MXNET_DLL int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname);
+/*! \brief attrs of the out node only, as 2*out_size key/value strings
+ *  (parity: c_api.h:709) */
+MXNET_DLL int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                                      const char ***out);
 MXNET_DLL int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
                                const char ***out);
 MXNET_DLL int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out);
@@ -228,6 +308,15 @@ MXNET_DLL int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
 MXNET_DLL int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
                                 NDArrayHandle **out);
 MXNET_DLL int MXExecutorPrint(ExecutorHandle handle, const char **out_str);
+/*! \brief install a per-op monitor called with every internal op output
+ *  (parity: c_api.h:1055); stats come from the one real execution */
+MXNET_DLL int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                           ExecutorMonitorCallback callback,
+                                           void *callback_handle);
+/*! \brief register a C-implemented custom operator (parity: c_api.h:1464);
+ *  reachable afterwards as Custom(..., op_type=...) from any frontend */
+MXNET_DLL int MXCustomOpRegister(const char *op_type,
+                                 CustomOpPropCreator creator);
 
 /* --------------------------------------------------------------- KVStore */
 MXNET_DLL int MXKVStoreCreate(const char *type, KVStoreHandle *out);
@@ -254,6 +343,12 @@ MXNET_DLL int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
                                             int barrier_before_exit);
 MXNET_DLL int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id,
                                       int *number, int timeout_sec);
+/*! \brief process-role predicates (parity: c_api.h:1288-1304); driven by
+ *  MXTPU_ROLE/DMLC_ROLE — in the TPU allreduce design every process is a
+ *  worker unless the launcher says otherwise */
+MXNET_DLL int MXKVStoreIsWorkerNode(int *ret);
+MXNET_DLL int MXKVStoreIsServerNode(int *ret);
+MXNET_DLL int MXKVStoreIsSchedulerNode(int *ret);
 /*! \brief reference spelling kept verbatim (c_api.h:1243).  ``body`` is a
  *  NUL-terminated C string, so it must not contain embedded NUL bytes —
  *  for head=0 (install optimizer) use pickle protocol 0, which is ASCII

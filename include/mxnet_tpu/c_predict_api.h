@@ -24,6 +24,7 @@ extern "C" {
 typedef unsigned int mx_uint;
 typedef float mx_float;
 typedef void *PredictorHandle;
+typedef void *NDListHandle;
 
 MXNET_DLL int MXPredCreate(const char *symbol_json_str,
                            const void *param_bytes, int param_size,
@@ -33,14 +34,41 @@ MXNET_DLL int MXPredCreate(const char *symbol_json_str,
                            const mx_uint *input_shape_indptr,
                            const mx_uint *input_shape_data,
                            PredictorHandle *out);
+/*! \brief feature-extraction binding: the predictor's outputs become the
+ *  named internal node outputs (parity: c_predict_api.h:92) */
+MXNET_DLL int MXPredCreatePartialOut(const char *symbol_json_str,
+                                     const void *param_bytes, int param_size,
+                                     int dev_type, int dev_id,
+                                     mx_uint num_input_nodes,
+                                     const char **input_keys,
+                                     const mx_uint *input_shape_indptr,
+                                     const mx_uint *input_shape_data,
+                                     mx_uint num_output_nodes,
+                                     const char **output_keys,
+                                     PredictorHandle *out);
 MXNET_DLL int MXPredSetInput(PredictorHandle handle, const char *key,
                              const mx_float *data, mx_uint size);
+/*! \brief stepwise-forward protocol (parity: c_predict_api.h:150).  Under
+ *  XLA the graph is one compiled computation: the execution happens on the
+ *  first call, the remaining calls count the protocol down — a
+ *  `while (step_left > 0)` loop observes identical end state. */
+MXNET_DLL int MXPredPartialForward(PredictorHandle handle, int step,
+                                   int *step_left);
 MXNET_DLL int MXPredForward(PredictorHandle handle);
 MXNET_DLL int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
                                    mx_uint **shape_data, mx_uint *shape_ndim);
 MXNET_DLL int MXPredGetOutput(PredictorHandle handle, mx_uint index,
                               mx_float *data, mx_uint size);
 MXNET_DLL int MXPredFree(PredictorHandle handle);
+
+/*! \brief load an in-memory .params blob as an indexable list (parity:
+ *  c_predict_api.h:180-214 — the mean-image loader) */
+MXNET_DLL int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                             NDListHandle *out, mx_uint *out_length);
+MXNET_DLL int MXNDListGet(NDListHandle handle, mx_uint index,
+                          const char **out_key, const mx_float **out_data,
+                          const mx_uint **out_shape, mx_uint *out_ndim);
+MXNET_DLL int MXNDListFree(NDListHandle handle);
 
 #ifdef __cplusplus
 }

@@ -72,6 +72,40 @@ def nd_waitall():
     nd.waitall()
 
 
+def nd_wait_to_read(handle):
+    handle.wait_to_read()
+
+
+def nd_wait_to_write(handle):
+    # functional arrays: one pending-dispatch sync covers both directions
+    handle.wait_to_read()
+
+
+def nd_save_raw_bytes(handle):
+    return nd.save_raw_bytes(handle)
+
+
+def nd_load_from_raw_bytes(data):
+    return nd.load_from_raw_bytes(bytes(data))
+
+
+def nd_get_data_f32(handle):
+    """Host f32 copy whose buffer the C side hands out as MXNDArrayGetData;
+    every copy ever handed out is stashed on the NDArray so each returned
+    pointer stays valid for the handle's whole lifetime (the header's
+    contract).  Read-only by nature — XLA arrays are immutable, so writes
+    through the pointer cannot propagate (the reference returns a mutable
+    CPU pointer; cpp-package only reads through it)."""
+    buf = _np.ascontiguousarray(
+        handle.asnumpy().astype("<f4", copy=False)).tobytes()
+    refs = getattr(handle, "_c_data_ref", None)
+    if refs is None:
+        refs = []
+        handle._c_data_ref = refs
+    refs.append(buf)
+    return buf
+
+
 # ------------------------------------------------------------------- symbol
 def list_all_op_names():
     from .ops import registry
@@ -149,6 +183,19 @@ def pred_set_input(pred, name, data):
     pred.set_input(name, arr.reshape(shape))
 
 
+def pred_create_partial(symbol_json, param_bytes, dev_type, dev_id,
+                        input_names, input_shapes, output_names):
+    shapes = {n: tuple(int(x) for x in s)
+              for n, s in zip(input_names, input_shapes)}
+    return Predictor(symbol_json, bytes(param_bytes), shapes,
+                     _DEVTYPE.get(int(dev_type), "cpu"), int(dev_id),
+                     output_names=list(output_names))
+
+
+def pred_partial_forward(pred, step):
+    return int(pred.partial_forward(int(step)))
+
+
 def pred_forward(pred):
     pred.forward()
 
@@ -164,6 +211,55 @@ def pred_get_output_shape(pred, index):
 def pred_get_output(pred, index):
     out = pred.get_output(int(index))
     return _np.ascontiguousarray(out.astype("<f4", copy=False)).tobytes()
+
+
+class _NDList(object):
+    """In-memory .params blob exposed as an indexable list (parity:
+    MXAPINDList, reference c_predict_api.cc:180-214 — the mean-image
+    loader).  Keys, f32 buffers and shapes are cached so the C pointers
+    stay valid while the handle lives."""
+
+    def __init__(self, blob):
+        import io as _io
+        import tempfile
+        import os
+        # nd.load works on paths; stage the blob (small: mean images)
+        fd, path = tempfile.mkstemp(suffix=".params")
+        try:
+            with _io.open(fd, "wb") as f:
+                f.write(blob)
+            data = nd.load(path)
+        finally:
+            os.unlink(path)
+        if isinstance(data, dict):
+            self.keys = list(data.keys())
+            arrays = [data[k] for k in self.keys]
+        else:
+            self.keys = [""] * len(data)
+            arrays = list(data)
+        self.shapes = [tuple(int(x) for x in a.shape) for a in arrays]
+        self.bufs = [_np.ascontiguousarray(
+            a.asnumpy().astype("<f4", copy=False)).tobytes() for a in arrays]
+        # shapes pre-packed as little-endian uint32 so the C side can hand
+        # out a pointer that stays valid for the handle's lifetime
+        self.shape_bufs = [_np.asarray(s, "<u4").tobytes() or b"\0"
+                           for s in self.shapes]
+
+    def __len__(self):
+        return len(self.keys)
+
+
+def ndlist_create(blob):
+    lst = _NDList(bytes(blob))
+    return lst, len(lst)
+
+
+def ndlist_get(lst, index):
+    """-> (key, data bytes, shape bytes, ndim); every object is owned by
+    the list, so the C pointers derived from them live as long as the
+    NDListHandle (the reference's validity contract)."""
+    i = int(index)
+    return lst.keys[i], lst.bufs[i], lst.shape_bufs[i], len(lst.shapes[i])
 
 
 # ------------------------------------------------------------------- random
@@ -352,6 +448,46 @@ def symbol_list_attr(handle):
     return out
 
 
+def symbol_list_attr_shallow(handle):
+    """Attrs of the out node(s) only, plain keys (parity:
+    MXSymbolListAttrShallow / nnvm ListAttrs non-recursive)."""
+    from .symbol import _attr_str
+    out = []
+    seen = set()
+    for node, _ in _sym(handle)._outputs:
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        d = dict(node.attr)
+        if not node.is_var:
+            d.update({k: _attr_str(v) for k, v in node.params.items()})
+        for k in sorted(d):
+            out.append(k)
+            out.append(str(d[k]))
+    return out
+
+
+def symbol_get_name(handle):
+    return _sym(handle).name
+
+
+def symbol_get_children(handle):
+    """Group of the output nodes' direct inputs (parity:
+    MXSymbolGetChildren / nnvm Symbol::GetChildren).  A leaf symbol yields
+    an empty group — the reference call succeeds there too (its python
+    wrapper maps the empty result to None)."""
+    from .symbol import Symbol
+    outs = []
+    for node, _ in _sym(handle)._outputs:
+        outs.extend(getattr(node, "inputs", ()))
+    return Symbol(outs)
+
+
+def symbol_save_to_file(handle, fname):
+    with open(fname, "w") as f:
+        f.write(_sym(handle).tojson())
+
+
 def symbol_infer_type(handle, names, dtype_codes):
     kwargs = {n: _np.dtype(_DTYPE_CODE.get(int(c), "float32"))
               for n, c in zip(names, dtype_codes)}
@@ -394,6 +530,13 @@ def executor_backward(ex, head_grad_handles):
 
 def executor_outputs(ex):
     return list(ex.outputs)
+
+
+def executor_set_monitor(ex, fn, capsule):
+    """``fn`` is the native call_monitor bridge (NativeCallMonitor in
+    src/c_api/c_api.cc); the executor's python-side monitor protocol is
+    callback(name, NDArray)."""
+    ex.set_monitor_callback(lambda name, arr: fn(capsule, str(name), arr))
 
 
 def executor_print(ex):
@@ -583,3 +726,80 @@ def recordio_reader_seek(handle, pos):
 
 def recordio_close(handle):
     handle.close()
+
+
+# --------------------------------------------------- native custom operators
+_REQ_NAME = {0: "null", 1: "write", 2: "inplace", 3: "add"}
+_REQ_CODE = {v: k for k, v in _REQ_NAME.items()}
+
+
+def custom_op_register_native(op_type, prop_create, prop_call, op_call,
+                              creator_capsule):
+    """Register a C-implemented custom op (parity: MXCustomOpRegister,
+    reference c_api.h:1464 + custom-inl.h).  ``prop_create``/``prop_call``/
+    ``op_call`` are the native bridges from src/c_api/c_api.cc that drive
+    the user's CustomOpPropInfo/CustomOpInfo callback tables; this shim
+    wraps them in the frontend CustomOp/CustomOpProp classes so the op runs
+    through the same pure_callback + custom_vjp path as Python custom ops
+    (ops/custom.py)."""
+    from . import operator as _operator
+    from .ndarray import _DTYPE_CODE
+
+    class _NativeOp(_operator.CustomOp):
+        def __init__(self, opinfo):
+            self._opinfo = opinfo
+
+        def forward(self, is_train, req, in_data, out_data, aux):
+            tensors = list(in_data) + list(out_data) + list(aux)
+            tags = [0] * len(in_data) + [1] * len(out_data) + [4] * len(aux)
+            reqs = [_REQ_CODE.get(r, 1) for r in req]
+            op_call(self._opinfo, "forward", tensors, tags, reqs,
+                    int(bool(is_train)))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            # reference tag/order protocol (custom.cc Backward): in_data(0),
+            # out_data(1), in_grad(2), aux(4), out_grad(3)
+            tensors = (list(in_data) + list(out_data) + list(in_grad)
+                       + list(aux) + list(out_grad))
+            tags = ([0] * len(in_data) + [1] * len(out_data)
+                    + [2] * len(in_grad) + [4] * len(aux)
+                    + [3] * len(out_grad))
+            reqs = [_REQ_CODE.get(r, 1) for r in req]
+            op_call(self._opinfo, "backward", tensors, tags, reqs, 1)
+
+    class _NativeProp(_operator.CustomOpProp):
+        def __init__(self, **kwargs):
+            super(_NativeProp, self).__init__(need_top_grad=True)
+            keys = [str(k) for k in kwargs]
+            vals = [str(kwargs[k]) for k in kwargs]
+            self._info = prop_create(creator_capsule, str(op_type), keys,
+                                     vals)
+
+        def list_arguments(self):
+            return prop_call(self._info, "list_arguments", None)
+
+        def list_outputs(self):
+            return prop_call(self._info, "list_outputs", None)
+
+        def list_auxiliary_states(self):
+            return prop_call(self._info, "list_aux", None)
+
+        def infer_shape(self, in_shape):
+            return prop_call(self._info, "infer_shape",
+                             ([tuple(int(d) for d in s) for s in in_shape],
+                              len(self.list_outputs()),
+                              len(self.list_auxiliary_states())))
+
+        def declare_backward_dependency(self, out_grad, in_data, out_data):
+            return prop_call(self._info, "backward_deps",
+                             (list(out_grad), list(in_data), list(out_data)))
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            codes = [_DTYPE_CODE.get(_np.dtype(d), 0) for d in in_dtypes]
+            opinfo = prop_call(self._info, "create_operator",
+                               (str(ctx),
+                                [tuple(int(d) for d in s)
+                                 for s in in_shapes], codes))
+            return _NativeOp(opinfo)
+
+    _operator.register(str(op_type))(_NativeProp)

@@ -45,7 +45,8 @@ def _platform_devtype(dev):
 class NDArray(object):
     """Multi-dimensional array on a device (parity: mx.nd.NDArray)."""
 
-    __slots__ = ("_data", "_base", "_chain", "_ctx", "writable")
+    __slots__ = ("_data", "_base", "_chain", "_ctx", "writable",
+                 "_c_data_ref", "__weakref__")
 
     def __init__(self, data=None, ctx=None, base=None, chain=(), writable=True):
         self._data = data          # jax.Array when root, else None
@@ -555,7 +556,8 @@ def save(fname, data):
         names, arrays = [""] * len(data), list(data)
         if not all(isinstance(a, NDArray) for a in arrays):
             raise MXNetError("save only supports NDArray contents")
-    with open(fname, "wb") as f:
+    from .base import smart_open
+    with smart_open(fname, "wb") as f:
         f.write(struct.pack("<QQ", _MAGIC, 0))
         f.write(struct.pack("<Q", len(arrays)))
         for name, arr in zip(names, arrays):
@@ -569,9 +571,36 @@ def save(fname, data):
             f.write(npv.tobytes())
 
 
+def save_raw_bytes(arr):
+    """One NDArray as self-contained bytes (parity: NDArray::Save via
+    MXNDArraySaveRawBytes, reference c_api.h:256 — the serialization
+    primitive under kvstore state transfer).  Same field layout as the
+    .params entries, minus the name."""
+    npv = np.asarray(arr.value)
+    head = struct.pack("<QII", _MAGIC, _dtype_to_code(arr.dtype), npv.ndim)
+    dims = struct.pack("<%dq" % npv.ndim, *npv.shape) if npv.ndim else b""
+    return head + dims + npv.tobytes()
+
+
+def load_from_raw_bytes(buf):
+    """Inverse of :func:`save_raw_bytes` (parity: MXNDArrayLoadFromRawBytes,
+    reference c_api.h:246)."""
+    magic, code, ndim = struct.unpack_from("<QII", buf, 0)
+    if magic != _MAGIC:
+        raise MXNetError("invalid NDArray raw bytes")
+    ofs = 16
+    shape = struct.unpack_from("<%dq" % ndim, buf, ofs) if ndim else ()
+    ofs += 8 * ndim
+    dt = _code_to_dtype(code)
+    count = int(np.prod(shape)) if shape else 1
+    npv = np.frombuffer(buf, dtype=dt, count=count, offset=ofs)
+    return array(npv.reshape(shape), dtype=dt)
+
+
 def load(fname):
     """Load NDArrays saved by :func:`save` (parity: mx.nd.load)."""
-    with open(fname, "rb") as f:
+    from .base import smart_open
+    with smart_open(fname, "rb") as f:
         magic, _ = struct.unpack("<QQ", f.read(16))
         if magic != _MAGIC:
             raise MXNetError("invalid NDArray file format")

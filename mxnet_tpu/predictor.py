@@ -29,11 +29,28 @@ class Predictor(object):
     """
 
     def __init__(self, symbol, param_blob, input_shapes, dev_type="cpu",
-                 dev_id=0):
+                 dev_id=0, output_names=None):
         from .context import Context
         if isinstance(symbol, (str, bytes)):
             symbol = sym_mod.load_json(
                 symbol.decode() if isinstance(symbol, bytes) else symbol)
+        if output_names:
+            # feature-extraction binding: outputs become the named internal
+            # node outputs (parity: MXPredCreatePartialOut, reference
+            # c_predict_api.h:92 + c_predict_api.cc output_keys matching)
+            internals = symbol.get_internals()
+            names = internals.list_outputs()
+            picked = []
+            for key in output_names:
+                if key in names:
+                    picked.append(names.index(key))
+                elif key + "_output" in names:
+                    picked.append(names.index(key + "_output"))
+                else:
+                    raise MXNetError("output %r not found in graph (%d "
+                                     "internal outputs)" % (key, len(names)))
+            symbol = sym_mod.Symbol(
+                [internals._outputs[i] for i in picked])
         self.symbol = symbol
         ctx = Context(dev_type, dev_id)
         arg_params, aux_params = _load_params(param_blob)
@@ -77,6 +94,22 @@ class Predictor(object):
     def forward(self):
         """(parity: MXPredForward)"""
         self._outputs = self._executor.forward(is_train=False)
+
+    def partial_forward(self, step):
+        """Stepwise-forward protocol (parity: MXPredPartialForward,
+        reference c_predict_api.h:150).  The reference runs graph nodes
+        [0, step); under XLA the graph is ONE compiled computation, so the
+        real execution happens on the first call and the remaining calls
+        count the protocol down — the caller's
+        ``while (step_left > 0) partial_forward(++step)`` loop observes
+        identical end state.  Returns step_left."""
+        from .symbol import _topo
+        n_steps = max(1, sum(
+            1 for n in _topo([nd_ for nd_, _ in self.symbol._outputs])
+            if not n.is_var))
+        if self._outputs is None:
+            self.forward()
+        return max(0, n_steps - int(step))
 
     def get_output_shape(self, index=0):
         """(parity: MXPredGetOutputShape)"""

@@ -242,6 +242,275 @@ PyObject *NativeCallUpdater(PyObject *, PyObject *args) {
 PyMethodDef g_updater_def = {"call_updater", NativeCallUpdater, METH_VARARGS,
                              "bridge from python kvstore to the C updater"};
 
+/* ------------------------------------------ executor monitor C trampoline */
+struct MonitorClosure {
+  ExecutorMonitorCallback fn;
+  void *handle;
+};
+
+void FreeMonitorClosure(PyObject *cap) {
+  delete reinterpret_cast<MonitorClosure *>(
+      PyCapsule_GetPointer(cap, "mxtpu_monitor"));
+}
+
+PyObject *NativeCallMonitor(PyObject *, PyObject *args) {
+  PyObject *cap = nullptr, *arr = nullptr;
+  const char *name = nullptr;
+  if (!PyArg_ParseTuple(args, "OsO", &cap, &name, &arr)) return nullptr;
+  auto *c = reinterpret_cast<MonitorClosure *>(
+      PyCapsule_GetPointer(cap, "mxtpu_monitor"));
+  if (c == nullptr) return nullptr;
+  /* ownership of one reference transfers to the callback, which frees it
+   * with MXNDArrayFree (reference monitor protocol) */
+  Py_INCREF(arr);
+  c->fn(name, reinterpret_cast<NDArrayHandle>(arr), c->handle);
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_monitor_def = {"call_monitor", NativeCallMonitor, METH_VARARGS,
+                             "bridge from the executor monitor to C"};
+
+/* ------------------------------------------- custom-op native trampolines */
+void FreeCustomPropInfo(PyObject *cap) {
+  auto *info = reinterpret_cast<CustomOpPropInfo *>(
+      PyCapsule_GetPointer(cap, "mxtpu_custom_prop"));
+  if (info != nullptr) {
+    if (info->del != nullptr) info->del(info->p_del);
+    delete info;
+  }
+}
+
+void FreeCustomOpInfo(PyObject *cap) {
+  auto *info = reinterpret_cast<CustomOpInfo *>(
+      PyCapsule_GetPointer(cap, "mxtpu_custom_op"));
+  if (info != nullptr) {
+    if (info->del != nullptr) info->del(info->p_del);
+    delete info;
+  }
+}
+
+/* NULL-terminated char** from a prop list callback -> python list */
+PyObject *NamesToList(char **names) {
+  PyObject *l = PyList_New(0);
+  for (int i = 0; names != nullptr && names[i] != nullptr; ++i) {
+    PyObject *s = PyUnicode_FromString(names[i]);
+    PyList_Append(l, s);
+    Py_DECREF(s);
+  }
+  return l;
+}
+
+/* (cap, op_type, keys, vals) -> prop-info capsule */
+PyObject *NativeCustomPropCreate(PyObject *, PyObject *args) {
+  PyObject *cap = nullptr, *keys = nullptr, *vals = nullptr;
+  const char *op_type = nullptr;
+  if (!PyArg_ParseTuple(args, "OsOO", &cap, &op_type, &keys, &vals)) {
+    return nullptr;
+  }
+  auto creator = reinterpret_cast<CustomOpPropCreator>(
+      PyCapsule_GetPointer(cap, "mxtpu_custom_creator"));
+  if (creator == nullptr) return nullptr;
+  Py_ssize_t n = PyList_Size(keys);
+  std::vector<std::string> kstr, vstr;
+  std::vector<const char *> kptr, vptr;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *k = PyUnicode_AsUTF8(PyList_GetItem(keys, i));
+    const char *v = PyUnicode_AsUTF8(PyList_GetItem(vals, i));
+    if (k == nullptr || v == nullptr) return nullptr;
+    kstr.emplace_back(k);
+    vstr.emplace_back(v);
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    kptr.push_back(kstr[i].c_str());
+    vptr.push_back(vstr[i].c_str());
+  }
+  auto *info = new CustomOpPropInfo();
+  std::memset(info, 0, sizeof(*info));
+  if (!creator(op_type, static_cast<int>(n), kptr.data(), vptr.data(),
+               info)) {
+    delete info;
+    PyErr_SetString(PyExc_RuntimeError, "CustomOpPropCreator failed");
+    return nullptr;
+  }
+  return PyCapsule_New(info, "mxtpu_custom_prop", FreeCustomPropInfo);
+}
+
+/* (prop_cap, method, payload) -> method-specific result */
+PyObject *NativeCustomPropCall(PyObject *, PyObject *args) {
+  PyObject *cap = nullptr, *payload = nullptr;
+  const char *method = nullptr;
+  if (!PyArg_ParseTuple(args, "OsO", &cap, &method, &payload)) {
+    return nullptr;
+  }
+  auto *info = reinterpret_cast<CustomOpPropInfo *>(
+      PyCapsule_GetPointer(cap, "mxtpu_custom_prop"));
+  if (info == nullptr) return nullptr;
+  std::string m = method;
+  if (m == "list_arguments" || m == "list_outputs" || m == "list_aux") {
+    char **names = nullptr;
+    bool ok = (m == "list_arguments")
+        ? info->list_arguments(&names, info->p_list_arguments)
+        : (m == "list_outputs")
+            ? info->list_outputs(&names, info->p_list_outputs)
+            : info->list_auxiliary_states(&names,
+                                          info->p_list_auxiliary_states);
+    if (!ok) {
+      PyErr_SetString(PyExc_RuntimeError, "custom op list callback failed");
+      return nullptr;
+    }
+    return NamesToList(names);
+  }
+  if (m == "infer_shape") {
+    PyObject *in_shapes = PyTuple_GetItem(payload, 0);
+    long num_out = PyLong_AsLong(PyTuple_GetItem(payload, 1));
+    long num_aux = PyLong_AsLong(PyTuple_GetItem(payload, 2));
+    Py_ssize_t nin = PyList_Size(in_shapes);
+    size_t total = static_cast<size_t>(nin + num_out + num_aux);
+    std::vector<std::vector<unsigned>> dims(nin);
+    std::vector<int> ndims(total, 0);
+    std::vector<unsigned *> shapes(total, nullptr);
+    for (Py_ssize_t i = 0; i < nin; ++i) {
+      PyObject *t = PyList_GetItem(in_shapes, i);
+      Py_ssize_t nd = PyTuple_Size(t);
+      for (Py_ssize_t j = 0; j < nd; ++j) {
+        dims[i].push_back(static_cast<unsigned>(
+            PyLong_AsUnsignedLong(PyTuple_GetItem(t, j))));
+      }
+      ndims[i] = static_cast<int>(nd);
+      shapes[i] = dims[i].data();
+    }
+    if (!info->infer_shape(static_cast<int>(total), ndims.data(),
+                           shapes.data(), info->p_infer_shape)) {
+      PyErr_SetString(PyExc_RuntimeError, "custom op infer_shape failed");
+      return nullptr;
+    }
+    PyObject *out = PyTuple_New(3);
+    size_t ofs = 0;
+    size_t counts[3] = {static_cast<size_t>(nin),
+                        static_cast<size_t>(num_out),
+                        static_cast<size_t>(num_aux)};
+    for (int g = 0; g < 3; ++g) {
+      PyObject *group = PyList_New(counts[g]);
+      for (size_t i = 0; i < counts[g]; ++i, ++ofs) {
+        PyObject *t = PyTuple_New(ndims[ofs]);
+        for (int j = 0; j < ndims[ofs]; ++j) {
+          PyTuple_SET_ITEM(t, j, PyLong_FromUnsignedLong(shapes[ofs][j]));
+        }
+        PyList_SET_ITEM(group, i, t);
+      }
+      PyTuple_SET_ITEM(out, g, group);  // steals the reference — no leak
+    }
+    return out;
+  }
+  if (m == "backward_deps") {
+    std::vector<int> og, idt, odt;
+    PyObject *lists[3] = {PyTuple_GetItem(payload, 0),
+                          PyTuple_GetItem(payload, 1),
+                          PyTuple_GetItem(payload, 2)};
+    std::vector<int> *dsts[3] = {&og, &idt, &odt};
+    for (int g = 0; g < 3; ++g) {
+      Py_ssize_t n = PyList_Size(lists[g]);
+      for (Py_ssize_t i = 0; i < n; ++i) {
+        dsts[g]->push_back(static_cast<int>(
+            PyLong_AsLong(PyList_GetItem(lists[g], i))));
+      }
+    }
+    int num_deps = 0;
+    int *rdeps = nullptr;
+    if (!info->declare_backward_dependency(og.data(), idt.data(), odt.data(),
+                                           &num_deps, &rdeps,
+                                           info->p_declare_backward_dependency)) {
+      PyErr_SetString(PyExc_RuntimeError, "custom op backward_deps failed");
+      return nullptr;
+    }
+    PyObject *l = PyList_New(num_deps);
+    for (int i = 0; i < num_deps; ++i) {
+      PyList_SET_ITEM(l, i, PyLong_FromLong(rdeps[i]));
+    }
+    return l;
+  }
+  if (m == "create_operator") {
+    const char *ctx = PyUnicode_AsUTF8(PyTuple_GetItem(payload, 0));
+    PyObject *in_shapes = PyTuple_GetItem(payload, 1);
+    PyObject *dtypes = PyTuple_GetItem(payload, 2);
+    if (ctx == nullptr) return nullptr;
+    Py_ssize_t nin = PyList_Size(in_shapes);
+    std::vector<std::vector<unsigned>> dims(nin);
+    std::vector<int> ndims(nin), dt(nin);
+    std::vector<unsigned *> shapes(nin);
+    for (Py_ssize_t i = 0; i < nin; ++i) {
+      PyObject *t = PyList_GetItem(in_shapes, i);
+      Py_ssize_t nd = PyTuple_Size(t);
+      for (Py_ssize_t j = 0; j < nd; ++j) {
+        dims[i].push_back(static_cast<unsigned>(
+            PyLong_AsUnsignedLong(PyTuple_GetItem(t, j))));
+      }
+      ndims[i] = static_cast<int>(nd);
+      shapes[i] = dims[i].data();
+      dt[i] = static_cast<int>(PyLong_AsLong(PyList_GetItem(dtypes, i)));
+    }
+    auto *op = new CustomOpInfo();
+    std::memset(op, 0, sizeof(*op));
+    if (!info->create_operator(ctx, static_cast<int>(nin), shapes.data(),
+                               ndims.data(), dt.data(), op,
+                               info->p_create_operator)) {
+      delete op;
+      PyErr_SetString(PyExc_RuntimeError, "custom op create_operator failed");
+      return nullptr;
+    }
+    return PyCapsule_New(op, "mxtpu_custom_op", FreeCustomOpInfo);
+  }
+  PyErr_SetString(PyExc_ValueError, "unknown custom-prop method");
+  return nullptr;
+}
+
+/* (op_cap, kind, tensors, tags, reqs, is_train) -> None */
+PyObject *NativeCustomOpCall(PyObject *, PyObject *args) {
+  PyObject *cap = nullptr, *tensors = nullptr, *tags = nullptr,
+           *reqs = nullptr;
+  const char *kind = nullptr;
+  int is_train = 0;
+  if (!PyArg_ParseTuple(args, "OsOOOi", &cap, &kind, &tensors, &tags, &reqs,
+                        &is_train)) {
+    return nullptr;
+  }
+  auto *op = reinterpret_cast<CustomOpInfo *>(
+      PyCapsule_GetPointer(cap, "mxtpu_custom_op"));
+  if (op == nullptr) return nullptr;
+  Py_ssize_t n = PyList_Size(tensors);
+  std::vector<void *> ptrs(n);
+  std::vector<int> tg(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    ptrs[i] = PyList_GetItem(tensors, i);  // borrowed PyObject* handles
+    tg[i] = static_cast<int>(PyLong_AsLong(PyList_GetItem(tags, i)));
+  }
+  Py_ssize_t nr = PyList_Size(reqs);
+  std::vector<int> rq(nr);
+  for (Py_ssize_t i = 0; i < nr; ++i) {
+    rq[i] = static_cast<int>(PyLong_AsLong(PyList_GetItem(reqs, i)));
+  }
+  bool ok = (std::string(kind) == "forward")
+      ? op->forward(static_cast<int>(n), ptrs.data(), tg.data(), rq.data(),
+                    is_train != 0, op->p_forward)
+      : op->backward(static_cast<int>(n), ptrs.data(), tg.data(), rq.data(),
+                     is_train != 0, op->p_backward);
+  if (!ok) {
+    PyErr_SetString(PyExc_RuntimeError, "custom op compute callback failed");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+PyMethodDef g_custom_create_def = {
+    "custom_prop_create", NativeCustomPropCreate, METH_VARARGS,
+    "create a native CustomOpPropInfo from the registered creator"};
+PyMethodDef g_custom_prop_def = {
+    "custom_prop_call", NativeCustomPropCall, METH_VARARGS,
+    "invoke a CustomOpPropInfo callback"};
+PyMethodDef g_custom_op_def = {
+    "custom_op_call", NativeCustomOpCall, METH_VARARGS,
+    "invoke a CustomOpInfo forward/backward callback"};
+
 /* stable operator-creator handles (PyUnicode op names, never freed) */
 std::vector<PyObject *> g_creators;
 
@@ -313,6 +582,80 @@ int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
 int MXNDArrayFree(NDArrayHandle handle) {
   API_BEGIN();
   Py_XDECREF(reinterpret_cast<PyObject *>(handle));
+  API_END();
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("nd_wait_to_read", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("nd_wait_to_write", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("nd_save_raw_bytes", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    last_error = FetchPyError();
+    return -1;
+  }
+  scratch.json.assign(buf, static_cast<size_t>(len));
+  Py_DECREF(r);
+  *out_size = scratch.json.size();
+  *out_buf = scratch.json.data();
+  API_END();
+}
+
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(buf), static_cast<Py_ssize_t>(size));
+  PyObject *args = Py_BuildValue("(N)", bytes);
+  PyObject *r = CallShim("nd_load_from_raw_bytes", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXNDArrayGetData(NDArrayHandle handle, mx_float **out_pdata) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(handle));
+  PyObject *r = CallShim("nd_get_data_f32", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  /* the shim stashes the bytes object on the NDArray, so the buffer
+   * outlives this borrowed pointer for as long as the handle does */
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  int rc = PyBytes_AsStringAndSize(r, &buf, &len);
+  Py_DECREF(r);
+  if (rc != 0) {
+    last_error = FetchPyError();
+    return -1;
+  }
+  *out_pdata = reinterpret_cast<mx_float *>(buf);
   API_END();
 }
 
@@ -859,6 +1202,65 @@ int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
   API_END();
 }
 
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                            const char ***out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(symbol));
+  PyObject *r = CallShim("symbol_list_attr_shallow", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  mx_uint n = 0;
+  if (StrListOut(r, &n, out) != 0) {
+    Py_DECREF(r);
+    return -1;
+  }
+  Py_DECREF(r);
+  *out_size = n / 2;  // reference convention: pairs, size = pair count
+  API_END();
+}
+
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(symbol));
+  PyObject *r = CallShim("symbol_get_name", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  if (r == Py_None) {
+    *success = 0;
+    *out = nullptr;
+  } else {
+    if (StrOut(r, &scratch.json) != 0) {
+      Py_DECREF(r);
+      return -1;
+    }
+    *out = scratch.json.c_str();
+    *success = 1;
+  }
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXSymbolGetChildren(SymbolHandle symbol, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(symbol));
+  PyObject *r = CallShim("symbol_get_children", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(Os)", reinterpret_cast<PyObject *>(symbol),
+                                 fname);
+  PyObject *r = CallShim("symbol_save_to_file", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
 int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out) {
   API_BEGIN();
   PyObject *args = Py_BuildValue("(O)", reinterpret_cast<PyObject *>(symbol));
@@ -1145,7 +1547,70 @@ int MXExecutorPrint(ExecutorHandle handle, const char **out_str) {
   API_END();
 }
 
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle) {
+  API_BEGIN();
+  auto *closure = new MonitorClosure{callback, callback_handle};
+  PyObject *cap = PyCapsule_New(closure, "mxtpu_monitor", FreeMonitorClosure);
+  if (cap == nullptr) {
+    delete closure;
+    last_error = FetchPyError();
+    return -1;
+  }
+  PyObject *fn = PyCFunction_New(&g_monitor_def, nullptr);
+  PyObject *args = Py_BuildValue("(ONN)",
+                                 reinterpret_cast<PyObject *>(handle), fn,
+                                 cap);
+  PyObject *r = CallShim("executor_set_monitor", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXCustomOpRegister(const char *op_type, CustomOpPropCreator creator) {
+  API_BEGIN();
+  PyObject *cap = PyCapsule_New(reinterpret_cast<void *>(creator),
+                                "mxtpu_custom_creator", nullptr);
+  CHECK_PY(cap);
+  PyObject *args = Py_BuildValue(
+      "(sNNNN)", op_type, PyCFunction_New(&g_custom_create_def, nullptr),
+      PyCFunction_New(&g_custom_prop_def, nullptr),
+      PyCFunction_New(&g_custom_op_def, nullptr), cap);
+  PyObject *r = CallShim("custom_op_register_native", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  Py_DECREF(r);
+  API_END();
+}
+
 /* ----------------------------------------------------------------- KVStore */
+/* Role predicates (parity: c_api.h:1288-1304).  There are no separate
+ * server/scheduler processes in the TPU allreduce design — every process
+ * is a worker unless the launch contract says otherwise. */
+static int RoleIs(const char *want) {
+  const char *role = std::getenv("MXTPU_ROLE");
+  if (role == nullptr) role = std::getenv("DMLC_ROLE");
+  if (role == nullptr) role = "worker";
+  return std::strcmp(role, want) == 0 ? 1 : 0;
+}
+
+int MXKVStoreIsWorkerNode(int *ret) {
+  *ret = RoleIs("worker");
+  return 0;
+}
+
+int MXKVStoreIsServerNode(int *ret) {
+  *ret = RoleIs("server");
+  return 0;
+}
+
+int MXKVStoreIsSchedulerNode(int *ret) {
+  *ret = RoleIs("scheduler");
+  return 0;
+}
+
 int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
   API_BEGIN();
   PyObject *args = Py_BuildValue("(s)", type);
@@ -1612,6 +2077,101 @@ int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
   Py_DECREF(args);
   CHECK_PY(r);
   *out = r;
+  API_END();
+}
+
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes, const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes,
+                           const char **output_keys, PredictorHandle *out) {
+  API_BEGIN();
+  PyObject *names = PyTuple_New(num_input_nodes);
+  PyObject *shapes = PyTuple_New(num_input_nodes);
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    PyTuple_SET_ITEM(names, i, PyUnicode_FromString(input_keys[i]));
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyTuple_SET_ITEM(shapes, i, ShapeTuple(input_shape_data + lo, hi - lo));
+  }
+  PyObject *blob = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char *>(param_bytes), param_size);
+  PyObject *args = Py_BuildValue("(sNiiNNN)", symbol_json_str, blob,
+                                 dev_type, dev_id, names, shapes,
+                                 StrList(num_output_nodes, output_keys));
+  PyObject *r = CallShim("pred_create_partial", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *out = r;
+  API_END();
+}
+
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(Oi)",
+                                 reinterpret_cast<PyObject *>(handle), step);
+  PyObject *r = CallShim("pred_partial_forward", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  *step_left = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length) {
+  API_BEGIN();
+  PyObject *blob = PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
+  PyObject *args = Py_BuildValue("(N)", blob);
+  PyObject *r = CallShim("ndlist_create", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  PyObject *lst = PyTuple_GetItem(r, 0);
+  *out_length = static_cast<mx_uint>(
+      PyLong_AsUnsignedLong(PyTuple_GetItem(r, 1)));
+  Py_INCREF(lst);
+  Py_DECREF(r);
+  *out = lst;
+  API_END();
+}
+
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const mx_float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim) {
+  API_BEGIN();
+  PyObject *args = Py_BuildValue("(OI)",
+                                 reinterpret_cast<PyObject *>(handle), index);
+  PyObject *r = CallShim("ndlist_get", args);
+  Py_DECREF(args);
+  CHECK_PY(r);
+  /* every returned pointer aliases an object OWNED BY THE LIST HANDLE
+   * (key str, data bytes, packed-u32 shape bytes), so all entries stay
+   * valid simultaneously until MXNDListFree — the reference's contract.
+   * PyUnicode_AsUTF8's buffer is cached inside the str object. */
+  const char *key = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  char *buf = nullptr, *shp = nullptr;
+  Py_ssize_t blen = 0, slen = 0;
+  if (key == nullptr ||
+      PyBytes_AsStringAndSize(PyTuple_GetItem(r, 1), &buf, &blen) != 0 ||
+      PyBytes_AsStringAndSize(PyTuple_GetItem(r, 2), &shp, &slen) != 0) {
+    Py_DECREF(r);
+    last_error = FetchPyError();
+    return -1;
+  }
+  *out_ndim = static_cast<mx_uint>(
+      PyLong_AsUnsignedLong(PyTuple_GetItem(r, 3)));
+  *out_key = key;
+  *out_data = reinterpret_cast<const mx_float *>(buf);
+  *out_shape = reinterpret_cast<const mx_uint *>(shp);
+  Py_DECREF(r);
+  API_END();
+}
+
+int MXNDListFree(NDListHandle handle) {
+  API_BEGIN();
+  Py_XDECREF(reinterpret_cast<PyObject *>(handle));
   API_END();
 }
 

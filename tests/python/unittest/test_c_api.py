@@ -190,6 +190,9 @@ def test_cpp_example_binary(libmx, tmp_path):
     assert res.returncode == 0, res.stderr
     assert "output shape: (3, 4)" in res.stdout
     assert res.stdout.count("argmax") == 3
+    # the partial-out feature-extraction path through the .so
+    assert "FEATURES OK" in res.stdout
+    assert "feature shape: (3, 128)" in res.stdout
 
 
 def test_cpp_train_binary(libmx):
@@ -251,3 +254,79 @@ def test_recordio_c_api(libmx, tmp_path):
         got.append(ctypes.string_at(buf, size.value))
     assert got == payloads
     _check(libmx, libmx.MXRecordIOReaderFree(r))
+
+
+def test_c_predict_partial_out_and_ndlist(libmx, tmp_path):
+    """MXPredCreatePartialOut binds up to a named hidden layer;
+    MXPredPartialForward counts the step protocol down; MXNDList* reads an
+    in-memory .params blob (the mean-image loader)."""
+    prefix = str(tmp_path / "mlp")
+    _train_tiny_mlp(prefix)
+    with open(prefix + "-symbol.json", "rb") as f:
+        sym_json = f.read()
+    with open(prefix + "-0004.params", "rb") as f:
+        params = f.read()
+    batch, dim = 3, 32
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    shapes = (ctypes.c_uint * 2)(batch, dim)
+    outs = (ctypes.c_char_p * 1)(b"fc1")
+    pred = ctypes.c_void_p()
+    _check(libmx, libmx.MXPredCreatePartialOut(
+        sym_json, params, len(params), 1, 0, 1, keys, indptr, shapes,
+        1, outs, ctypes.byref(pred)))
+    x = np.linspace(-1, 1, batch * dim).astype(np.float32)
+    _check(libmx, libmx.MXPredSetInput(
+        pred, b"data", x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint(x.size)))
+    step, left = 0, ctypes.c_int(1)
+    while left.value > 0:
+        step += 1
+        _check(libmx, libmx.MXPredPartialForward(pred, step,
+                                                 ctypes.byref(left)))
+    assert step > 1   # the protocol actually counted nodes down
+    sd = ctypes.POINTER(ctypes.c_uint)()
+    nd_ = ctypes.c_uint()
+    _check(libmx, libmx.MXPredGetOutputShape(pred, 0, ctypes.byref(sd),
+                                             ctypes.byref(nd_)))
+    shape = tuple(sd[i] for i in range(nd_.value))
+    assert shape == (batch, 128)
+    feat = np.zeros(batch * 128, np.float32)
+    _check(libmx, libmx.MXPredGetOutput(
+        pred, 0, feat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint(feat.size)))
+    _check(libmx, libmx.MXPredFree(pred))
+    # hidden layer must match the python-side internals binding
+    from mxnet_tpu.predictor import Predictor
+    py_pred = Predictor(sym_json.decode(), params, {"data": (batch, dim)},
+                        output_names=["fc1"])
+    py_pred.set_input("data", x.reshape(batch, dim))
+    py_pred.forward()
+    np.testing.assert_allclose(feat.reshape(batch, 128),
+                               py_pred.get_output(0), rtol=1e-5)
+
+    # ---- NDList over the params blob itself
+    lst = ctypes.c_void_p()
+    length = ctypes.c_uint()
+    _check(libmx, libmx.MXNDListCreate(params, len(params),
+                                       ctypes.byref(lst),
+                                       ctypes.byref(length)))
+    assert length.value >= 6   # fc1-3 weight+bias
+    key = ctypes.c_char_p()
+    data_p = ctypes.POINTER(ctypes.c_float)()
+    shape_p = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    found = {}
+    for i in range(length.value):
+        _check(libmx, libmx.MXNDListGet(lst, i, ctypes.byref(key),
+                                        ctypes.byref(data_p),
+                                        ctypes.byref(shape_p),
+                                        ctypes.byref(ndim)))
+        shp = tuple(shape_p[j] for j in range(ndim.value))
+        n = int(np.prod(shp))
+        found[key.value.decode()] = np.ctypeslib.as_array(
+            data_p, shape=(n,)).reshape(shp).copy()
+    assert any(k.endswith("fc1_weight") for k in found)
+    wkey = [k for k in found if k.endswith("fc1_weight")][0]
+    assert found[wkey].shape == (128, 32)
+    _check(libmx, libmx.MXNDListFree(lst))

@@ -590,3 +590,285 @@ def test_bind_variants_and_infer_partial(libmx):
     assert b"deprecated" in lib.MXGetLastError()
     for h in args:
         _check(lib, lib.MXNDArrayFree(h))
+
+
+# --------------------------------------- round-4 C API surface (VERDICT #2)
+def test_ndarray_wait_rawbytes_getdata(libmx):
+    lib = libmx
+    h = _nd_create(lib, (3, 4))
+    val = np.arange(12, dtype=np.float32).reshape(3, 4)
+    _nd_set(lib, h, val)
+    _check(lib, lib.MXNDArrayWaitToRead(h))
+    _check(lib, lib.MXNDArrayWaitToWrite(h))
+    # raw-bytes round trip (the kvstore state-transfer primitive)
+    size = ctypes.c_size_t()
+    buf = ctypes.c_char_p()
+    _check(lib, lib.MXNDArraySaveRawBytes(h, ctypes.byref(size),
+                                          ctypes.byref(buf)))
+    raw = ctypes.string_at(buf, size.value)
+    h2 = Handle()
+    _check(lib, lib.MXNDArrayLoadFromRawBytes(raw, len(raw),
+                                              ctypes.byref(h2)))
+    np.testing.assert_array_equal(_nd_get(lib, h2), val)
+    # GetData: host f32 view
+    pdata = ctypes.POINTER(ctypes.c_float)()
+    _check(lib, lib.MXNDArrayGetData(h, ctypes.byref(pdata)))
+    got = np.ctypeslib.as_array(pdata, shape=(12,)).reshape(3, 4)
+    np.testing.assert_array_equal(got, val)
+    for hh in (h, h2):
+        _check(lib, lib.MXNDArrayFree(hh))
+
+
+def test_symbol_name_children_file_shallow(libmx, tmp_path):
+    lib = libmx
+    x = _variable(lib, "data")
+    fc = _compose(lib, _atomic(lib, "FullyConnected",
+                               ("num_hidden",), ("4",)), "fc", data=x)
+    nm = ctypes.c_char_p()
+    ok = ctypes.c_int()
+    _check(lib, lib.MXSymbolGetName(fc, ctypes.byref(nm), ctypes.byref(ok)))
+    assert ok.value == 1 and nm.value == b"fc"
+    # children: the fc node's direct inputs (data + implicit weight/bias)
+    kids = Handle()
+    _check(lib, lib.MXSymbolGetChildren(fc, ctypes.byref(kids)))
+    nout = ctypes.c_uint()
+    outs = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXSymbolListOutputs(kids, ctypes.byref(nout),
+                                        ctypes.byref(outs)))
+    names = {outs[i] for i in range(nout.value)}
+    assert b"data" in names and any(b"weight" in s for s in names)
+    # save to file == save to JSON
+    fname = str(tmp_path / "sym.json").encode()
+    _check(lib, lib.MXSymbolSaveToFile(fc, fname))
+    js = ctypes.c_char_p()
+    _check(lib, lib.MXSymbolSaveToJSON(fc, ctypes.byref(js)))
+    assert open(fname.decode()).read() == js.value.decode()
+    # shallow attrs: only the out node's own attrs, plain keys
+    _check(lib, lib.MXSymbolSetAttr(fc, b"lr_mult", b"2"))
+    nattr = ctypes.c_uint()
+    pairs = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib, lib.MXSymbolListAttrShallow(fc, ctypes.byref(nattr),
+                                            ctypes.byref(pairs)))
+    d = {pairs[2 * i]: pairs[2 * i + 1] for i in range(nattr.value)}
+    assert d.get(b"lr_mult") == b"2" and d.get(b"num_hidden") == b"4"
+    for h in (kids, fc, x):
+        _check(lib, lib.MXSymbolFree(h))
+
+
+def test_kvstore_role_predicates(libmx):
+    lib = libmx
+    r = ctypes.c_int()
+    _check(lib, lib.MXKVStoreIsWorkerNode(ctypes.byref(r)))
+    assert r.value == 1
+    _check(lib, lib.MXKVStoreIsServerNode(ctypes.byref(r)))
+    assert r.value == 0
+    _check(lib, lib.MXKVStoreIsSchedulerNode(ctypes.byref(r)))
+    assert r.value == 0
+
+
+def test_executor_monitor_callback(libmx):
+    lib = libmx
+    x = _variable(lib, "data")
+    fc = _compose(lib, _atomic(lib, "FullyConnected",
+                               ("num_hidden",), ("3",)), "fcm", data=x)
+    act = _compose(lib, _atomic(lib, "Activation",
+                                ("act_type",), ("relu",)), "relum", data=fc)
+    args_h = [_nd_create(lib, s) for s in ((2, 5), (3, 5), (3,))]
+    for h, s in zip(args_h, ((2, 5), (3, 5), (3,))):
+        _nd_set(lib, h, np.ones(s, np.float32))
+    ex = Handle()
+    args_arr = (Handle * 3)(*args_h)
+    grads_arr = (Handle * 3)(None, None, None)
+    reqs_arr = (ctypes.c_uint * 3)(0, 0, 0)
+    _check(lib, lib.MXExecutorBind(act, 1, 0, 3, args_arr, grads_arr,
+                                   reqs_arr, 0, None, ctypes.byref(ex)))
+
+    MONITOR = ctypes.CFUNCTYPE(None, ctypes.c_char_p, Handle,
+                               ctypes.c_void_p)
+    seen = {}
+
+    def monitor(name, arr, _):
+        arr = Handle(arr)
+        seen[name.decode()] = _nd_get(lib, arr).copy()
+        _check(lib, lib.MXNDArrayFree(arr))
+
+    cb = MONITOR(monitor)
+    _check(lib, lib.MXExecutorSetMonitorCallback(ex, cb, None))
+    _check(lib, lib.MXExecutorForward(ex, 1))
+    assert any("fcm" in k for k in seen), sorted(seen)
+    fck = [k for k in seen if "fcm" in k][0]
+    # data ones(2,5) @ weight ones(3,5)^T + bias ones = 6
+    np.testing.assert_allclose(seen[fck], np.full((2, 3), 6.0), rtol=1e-5)
+    _check(lib, lib.MXExecutorFree(ex))
+    for h in (act, fc, x):
+        _check(lib, lib.MXSymbolFree(h))
+
+
+class _CCustomOpInfo(ctypes.Structure):
+    _FWD = ctypes.CFUNCTYPE(ctypes.c_bool, ctypes.c_int,
+                            ctypes.POINTER(ctypes.c_void_p),
+                            ctypes.POINTER(ctypes.c_int),
+                            ctypes.POINTER(ctypes.c_int), ctypes.c_bool,
+                            ctypes.c_void_p)
+    _DEL = ctypes.CFUNCTYPE(ctypes.c_bool, ctypes.c_void_p)
+    _fields_ = [("forward", _FWD), ("backward", _FWD), ("del_", _DEL),
+                ("p_forward", ctypes.c_void_p),
+                ("p_backward", ctypes.c_void_p),
+                ("p_del", ctypes.c_void_p)]
+
+
+class _CCustomOpPropInfo(ctypes.Structure):
+    _LIST = ctypes.CFUNCTYPE(ctypes.c_bool,
+                             ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+                             ctypes.c_void_p)
+    _INFER = ctypes.CFUNCTYPE(ctypes.c_bool, ctypes.c_int,
+                              ctypes.POINTER(ctypes.c_int),
+                              ctypes.POINTER(ctypes.POINTER(ctypes.c_uint)),
+                              ctypes.c_void_p)
+    _DEPS = ctypes.CFUNCTYPE(ctypes.c_bool, ctypes.POINTER(ctypes.c_int),
+                             ctypes.POINTER(ctypes.c_int),
+                             ctypes.POINTER(ctypes.c_int),
+                             ctypes.POINTER(ctypes.c_int),
+                             ctypes.POINTER(ctypes.POINTER(ctypes.c_int)),
+                             ctypes.c_void_p)
+    _CREATE = ctypes.CFUNCTYPE(ctypes.c_bool, ctypes.c_char_p, ctypes.c_int,
+                               ctypes.POINTER(ctypes.POINTER(ctypes.c_uint)),
+                               ctypes.POINTER(ctypes.c_int),
+                               ctypes.POINTER(ctypes.c_int),
+                               ctypes.POINTER(_CCustomOpInfo),
+                               ctypes.c_void_p)
+    _DEL = ctypes.CFUNCTYPE(ctypes.c_bool, ctypes.c_void_p)
+    _fields_ = [("list_arguments", _LIST), ("list_outputs", _LIST),
+                ("infer_shape", _INFER),
+                ("declare_backward_dependency", _DEPS),
+                ("create_operator", _CREATE),
+                ("list_auxiliary_states", _LIST), ("del_", _DEL),
+                ("p_list_arguments", ctypes.c_void_p),
+                ("p_list_outputs", ctypes.c_void_p),
+                ("p_infer_shape", ctypes.c_void_p),
+                ("p_declare_backward_dependency", ctypes.c_void_p),
+                ("p_create_operator", ctypes.c_void_p),
+                ("p_list_auxiliary_states", ctypes.c_void_p),
+                ("p_del", ctypes.c_void_p)]
+
+
+_CB_KEEPALIVE = []  # ctypes callbacks + string arenas must outlive the op
+
+
+def test_custom_op_register_via_c(libmx):
+    """A C-implemented custom op (out = 2*in) registered through
+    MXCustomOpRegister, then composed, bound, forward+backward through the
+    C API — the reference's CustomOpInfo callback-table contract end to
+    end (reference c_api.h:103-140, custom-inl.h)."""
+    lib = libmx
+
+    args_arena = (ctypes.c_char_p * 3)(b"data", None, None)
+    outs_arena = (ctypes.c_char_p * 2)(b"output", None)
+    aux_arena = (ctypes.c_char_p * 1)(None)
+
+    def list_args(out, _):
+        out[0] = args_arena
+        return True
+
+    def list_outs(out, _):
+        out[0] = outs_arena
+        return True
+
+    def list_aux(out, _):
+        out[0] = aux_arena
+        return True
+
+    def infer_shape(num_in, ndims, shapes, _):
+        # 1 input, 1 output: same shape (pointer reuse is copied out)
+        ndims[1] = ndims[0]
+        shapes[1] = shapes[0]
+        return True
+
+    def deps(out_grad, in_data, out_data, num_deps, rdeps, _):
+        arena = (ctypes.c_int * 1)(out_grad[0])
+        _CB_KEEPALIVE.append(arena)
+        num_deps[0] = 1
+        rdeps[0] = arena
+        return True
+
+    def forward(size, ptrs, tags, reqs, is_train, _):
+        tens = {0: [], 1: [], 4: []}
+        for i in range(size):
+            tens.setdefault(tags[i], []).append(Handle(ptrs[i]))
+        val = _nd_get(lib, tens[0][0])
+        _nd_set(lib, tens[1][0], 2.0 * val)
+        return True
+
+    def backward(size, ptrs, tags, reqs, is_train, _):
+        tens = {}
+        for i in range(size):
+            tens.setdefault(tags[i], []).append(Handle(ptrs[i]))
+        og = _nd_get(lib, tens[3][0])
+        _nd_set(lib, tens[2][0], 2.0 * og)   # in_grad = 2 * out_grad
+        return True
+
+    def create_op(ctx, num_in, shapes, ndims, dtypes, ret, _):
+        ret[0].forward = _CCustomOpInfo._FWD(forward)
+        ret[0].backward = _CCustomOpInfo._FWD(backward)
+        ret[0].del_ = _CCustomOpInfo._DEL(lambda s: True)
+        _CB_KEEPALIVE.extend([ret[0].forward, ret[0].backward, ret[0].del_])
+        return True
+
+    CREATOR = ctypes.CFUNCTYPE(ctypes.c_bool, ctypes.c_char_p, ctypes.c_int,
+                               ctypes.POINTER(ctypes.c_char_p),
+                               ctypes.POINTER(ctypes.c_char_p),
+                               ctypes.POINTER(_CCustomOpPropInfo))
+
+    def creator(op_type, num_kwargs, keys, vals, ret):
+        info = ret[0]
+        info.list_arguments = _CCustomOpPropInfo._LIST(list_args)
+        info.list_outputs = _CCustomOpPropInfo._LIST(list_outs)
+        info.list_auxiliary_states = _CCustomOpPropInfo._LIST(list_aux)
+        info.infer_shape = _CCustomOpPropInfo._INFER(infer_shape)
+        info.declare_backward_dependency = _CCustomOpPropInfo._DEPS(deps)
+        info.create_operator = _CCustomOpPropInfo._CREATE(create_op)
+        info.del_ = _CCustomOpPropInfo._DEL(lambda s: True)
+        _CB_KEEPALIVE.extend([info.list_arguments, info.list_outputs,
+                              info.list_auxiliary_states, info.infer_shape,
+                              info.declare_backward_dependency,
+                              info.create_operator, info.del_])
+        return True
+
+    creator_cb = CREATOR(creator)
+    _CB_KEEPALIVE.append(creator_cb)
+    _check(lib, lib.MXCustomOpRegister(b"cdouble", creator_cb))
+
+    # compose Custom(op_type=cdouble) and run fwd+bwd through the C API
+    x = _variable(lib, "data")
+    cust = _compose(lib, _atomic(lib, "Custom", ("op_type",), ("cdouble",)),
+                    "cd", data=x)
+    data_h = _nd_create(lib, (2, 3))
+    val = np.arange(6, dtype=np.float32).reshape(2, 3)
+    _nd_set(lib, data_h, val)
+    grad_h = _nd_create(lib, (2, 3))
+    _nd_set(lib, grad_h, np.zeros((2, 3), np.float32))
+    ex = Handle()
+    args_arr = (Handle * 1)(data_h)
+    grads_arr = (Handle * 1)(grad_h)
+    reqs_arr = (ctypes.c_uint * 1)(1)
+    _check(lib, lib.MXExecutorBind(cust, 1, 0, 1, args_arr, grads_arr,
+                                   reqs_arr, 0, None, ctypes.byref(ex)))
+    _check(lib, lib.MXExecutorForward(ex, 1))
+    outs_size = ctypes.c_uint()
+    outs_p = ctypes.POINTER(Handle)()
+    _check(lib, lib.MXExecutorOutputs(ex, ctypes.byref(outs_size),
+                                      ctypes.byref(outs_p)))
+    out = _nd_get(lib, Handle(outs_p[0]))
+    np.testing.assert_allclose(out, 2.0 * val, rtol=1e-6)
+    for i in range(outs_size.value):
+        _check(lib, lib.MXNDArrayFree(Handle(outs_p[i])))
+    # backward with explicit head grad: in_grad must be 2 * head
+    head = _nd_create(lib, (2, 3))
+    _nd_set(lib, head, np.ones((2, 3), np.float32))
+    heads = (Handle * 1)(head)
+    _check(lib, lib.MXExecutorBackward(ex, 1, heads))
+    np.testing.assert_allclose(_nd_get(lib, grad_h),
+                               np.full((2, 3), 2.0), rtol=1e-6)
+    _check(lib, lib.MXExecutorFree(ex))
+    for h in (cust, x):
+        _check(lib, lib.MXSymbolFree(h))
