@@ -67,13 +67,12 @@ class _FunctionalOptimizer(object):
             self.wd_mult[n] = optimizer.wd_mult.get(n, default_wm)
         self.kind = type(optimizer).__name__.lower()
         if self.kind not in ("sgd", "ccsgd", "nag", "adam", "rmsprop",
-                            "adagrad", "adadelta"):
+                             "adagrad", "adadelta", "sgld", "dcasgd",
+                             "test"):
             raise MXNetError(
-                "TrainStep supports sgd/nag/adam/rmsprop/adagrad/adadelta; "
-                "got %s (use the Module path for others)" % self.kind)
-        if self.kind == "rmsprop" and getattr(optimizer, "centered", False):
-            raise MXNetError("TrainStep implements the Tieleman (non-"
-                             "centered) RMSProp only; use the Module path")
+                "TrainStep supports sgd/nag/adam/rmsprop/adagrad/adadelta/"
+                "sgld/dcasgd/test; got %s (use the Module path for others)"
+                % self.kind)
 
     # ------------------------------------------------------------------ state
     def init_state(self, params):
@@ -87,11 +86,20 @@ class _FunctionalOptimizer(object):
             elif self.kind == "adam":
                 state[n] = (zeros(w), zeros(w))
             elif self.kind == "rmsprop":
-                state[n] = (zeros(w),)
+                state[n] = (zeros(w), zeros(w), zeros(w)) \
+                    if getattr(self.opt, "centered", False) else (zeros(w),)
             elif self.kind == "adagrad":
                 state[n] = (zeros(w),)
             elif self.kind == "adadelta":
                 state[n] = (zeros(w), zeros(w))
+            elif self.kind == "sgld":
+                state[n] = ()
+            elif self.kind == "dcasgd":
+                # (momentum?, previous_weight) — prev starts AT the weight
+                prev = _np.array(w, copy=True)
+                state[n] = (zeros(w), prev) if self.opt.momentum else (prev,)
+            elif self.kind == "test":
+                state[n] = (zeros(w),)
         return state
 
     # ------------------------------------------------------------------ hyper
@@ -106,8 +114,9 @@ class _FunctionalOptimizer(object):
         return {"lr": _np.float32(lr)}
 
     # ----------------------------------------------------------------- update
-    def update(self, name, w, g, state, hyper, t):
-        """One optimizer step; ``t`` is the 1-based traced update count."""
+    def update(self, name, w, g, state, hyper, t, rng=None):
+        """One optimizer step; ``t`` is the 1-based traced update count;
+        ``rng`` seeds stochastic rules (SGLD's Langevin noise)."""
         import jax.numpy as jnp
         from .ops.registry import OPS
         o = self.opt
@@ -144,8 +153,16 @@ class _FunctionalOptimizer(object):
                 epsilon=o.epsilon, **common)
             return nw, (nm, nv)
         if self.kind == "rmsprop":
+            cw = getattr(o, "clip_weights", None)
+            if getattr(o, "centered", False):
+                nw, nn, ng, ndl = OPS.get("rmspropalex_update").fn(
+                    w, g, state[0], state[1], state[2], gamma1=o.gamma1,
+                    gamma2=o.gamma2, epsilon=o.epsilon,
+                    clip_weights=-1.0 if cw is None else cw, **common)
+                return nw, (nn, ng, ndl)
             nw, nn = OPS.get("rmsprop_update").fn(
-                w, g, state[0], gamma1=o.gamma1, epsilon=o.epsilon, **common)
+                w, g, state[0], gamma1=o.gamma1, epsilon=o.epsilon,
+                clip_weights=-1.0 if cw is None else cw, **common)
             return nw, (nn,)
         if self.kind == "adagrad":
             grad = g * o.rescale_grad
@@ -163,6 +180,32 @@ class _FunctionalOptimizer(object):
                      / jnp.sqrt(acc_g + o.epsilon)) * grad
             acc_d = o.rho * state[1] + (1.0 - o.rho) * jnp.square(delta)
             return w - delta - wd * w, (acc_g, acc_d)
+        if self.kind == "sgld":
+            import jax
+            import zlib
+            grad = g * o.rescale_grad
+            if o.clip_gradient is not None:
+                grad = jnp.clip(grad, -o.clip_gradient, o.clip_gradient)
+            # crc32, not hash(): python's per-process hash salt would draw
+            # different noise on each worker of a data-parallel run
+            key = jax.random.fold_in(
+                jax.random.fold_in(rng, zlib.crc32(name.encode())
+                                   & 0x7FFFFFFF), t)
+            noise = jnp.sqrt(lr) * jax.random.normal(key, w.shape, w.dtype)
+            return w - lr / 2 * (grad + wd * w) + noise, ()
+        if self.kind == "dcasgd":
+            grad = g * o.rescale_grad
+            if o.clip_gradient is not None:
+                grad = jnp.clip(grad, -o.clip_gradient, o.clip_gradient)
+            prev = state[-1]
+            comp = grad + wd * w + o.lamda * grad * grad * (w - prev)
+            if len(state) == 2:
+                mon = state[0] * o.momentum - lr * comp
+                return w + mon, (mon, w)
+            return w - lr * comp, (w,)
+        if self.kind == "test":
+            nw = w + g * o.rescale_grad
+            return nw, (nw,)
         raise MXNetError("unreachable")
 
 
@@ -237,7 +280,7 @@ class TrainStep(object):
             for n in self.param_names:
                 g = grads[n].astype(params[n].dtype)
                 new_params[n], new_state[n] = self.fopt.update(
-                    n, params[n], g, opt_state[n], hyper, t)
+                    n, params[n], g, opt_state[n], hyper, t, rng=rng)
             new_aux = dict(aux)
             new_aux.update({k: v.astype(aux[k].dtype)
                             for k, v in aux_upd.items() if k in aux})
