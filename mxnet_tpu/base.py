@@ -39,6 +39,23 @@ def get_env(name, default=None, typ=None):
     return val
 
 
+def smart_open(uri, mode="rb"):
+    """Open a local path or a remote URI (parity: dmlc::Stream with
+    USE_S3/USE_HDFS, reference make/config.mk:136-144 — the reference's
+    RecordIO/params files can live on s3:// or hdfs://).  Remote schemes
+    route through fsspec, which resolves s3/gs/hdfs/http drivers at
+    runtime; local paths use plain open()."""
+    if "://" in str(uri):
+        try:
+            import fsspec
+        except ImportError:
+            raise MXNetError(
+                "remote URI %r requires fsspec (the dmlc::Stream S3/HDFS "
+                "equivalent)" % (uri,))
+        return fsspec.open(uri, mode).open()
+    return open(uri, mode)
+
+
 class Registry(object):
     """Generic name->entry registry (parity: dmlc registry used for ops/iters/metrics)."""
 

@@ -45,11 +45,12 @@ class MXRecordIO(object):
         self.open()
 
     def open(self):
+        from .base import smart_open
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
+            self.handle = smart_open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
+            self.handle = smart_open(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
