@@ -12,6 +12,7 @@
 #ifndef MXNET_CPP_MXNETCPP_H_
 #define MXNET_CPP_MXNETCPP_H_
 
+#include <cmath>
 #include <fstream>
 #include <map>
 #include <memory>
@@ -516,6 +517,166 @@ inline void Symbol::Save(const std::string &fname) const {
   if (!f) throw std::runtime_error("cannot open " + fname);
   f << ToJSON();
 }
+
+/* ---------------------------------------------------------------- DataIter */
+/* parity: reference cpp-package io.h MXDataIter — create a registered
+ * iterator by name (CSVIter, MNISTIter, ImageRecordIter, ...) with string
+ * params, then drive Next()/GetData()/GetLabel(). */
+class DataIter {
+ public:
+  DataIter(const std::string &name,
+           const std::vector<std::pair<std::string, std::string>> &params) {
+    mx_uint n = 0;
+    DataIterCreator *creators = nullptr;
+    Check(MXListDataIters(&n, &creators));
+    DataIterCreator creator = nullptr;
+    for (mx_uint i = 0; i < n; ++i) {
+      const char *nm = nullptr, *desc = nullptr;
+      Check(MXDataIterGetIterInfo(creators[i], &nm, &desc));
+      if (name == nm) {
+        creator = creators[i];
+        break;
+      }
+    }
+    if (creator == nullptr) {
+      throw std::runtime_error("no data iterator named " + name);
+    }
+    std::vector<const char *> keys, vals;
+    for (auto &kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    Check(MXDataIterCreateIter(creator,
+                               static_cast<mx_uint>(keys.size()),
+                               keys.data(), vals.data(), &handle_));
+  }
+  DataIter(const DataIter &) = delete;
+  DataIter &operator=(const DataIter &) = delete;
+  ~DataIter() {
+    if (handle_ != nullptr) MXDataIterFree(handle_);
+  }
+
+  bool Next() {
+    int has = 0;
+    Check(MXDataIterNext(handle_, &has));
+    return has != 0;
+  }
+  void BeforeFirst() { Check(MXDataIterBeforeFirst(handle_)); }
+  NDArray GetData() {
+    NDArrayHandle out = nullptr;
+    Check(MXDataIterGetData(handle_, &out));
+    return NDArray(out);
+  }
+  NDArray GetLabel() {
+    NDArrayHandle out = nullptr;
+    Check(MXDataIterGetLabel(handle_, &out));
+    return NDArray(out);
+  }
+  int GetPadNum() {
+    int pad = 0;
+    Check(MXDataIterGetPadNum(handle_, &pad));
+    return pad;
+  }
+
+ private:
+  DataIterHandle handle_ = nullptr;
+};
+
+/* ------------------------------------------------------------- Initializer */
+/* parity: reference cpp-package initializer.h — operator()(name, &array)
+ * fills a freshly allocated parameter.  Weight-shaped arrays get the
+ * distribution; *_bias/*_beta/moving_mean zero; *_gamma/moving_var one. */
+class Initializer {
+ public:
+  virtual ~Initializer() = default;
+  void operator()(const std::string &name, NDArray *arr) {
+    if (name.find("_bias") != std::string::npos ||
+        name.find("_beta") != std::string::npos ||
+        name.find("moving_mean") != std::string::npos) {
+      Fill(arr, 0.0f);
+    } else if (name.find("_gamma") != std::string::npos ||
+               name.find("moving_var") != std::string::npos) {
+      Fill(arr, 1.0f);
+    } else {
+      InitWeight(arr);
+    }
+  }
+
+ protected:
+  virtual void InitWeight(NDArray *arr) = 0;
+  static void Fill(NDArray *arr, float v) {
+    std::vector<mx_float> buf(arr->Size(), v);
+    arr->SyncCopyFromCPU(buf);
+  }
+};
+
+class Uniform : public Initializer {
+ public:
+  explicit Uniform(float scale = 0.07f) : scale_(scale), state_(1u) {}
+
+ protected:
+  void InitWeight(NDArray *arr) override {
+    std::vector<mx_float> buf(arr->Size());
+    for (auto &v : buf) v = (NextUnit(&state_) * 2.0f - 1.0f) * scale_;
+    arr->SyncCopyFromCPU(buf);
+  }
+  static float NextUnit(unsigned *s) {      // xorshift: hermetic, seedable
+    *s ^= *s << 13; *s ^= *s >> 17; *s ^= *s << 5;
+    return static_cast<float>(*s % 1000003u) / 1000003.0f;
+  }
+  float scale_;
+  unsigned state_;
+};
+
+class Xavier : public Uniform {
+ public:
+  explicit Xavier(float magnitude = 3.0f) : Uniform(0.0f),
+                                            magnitude_(magnitude) {}
+
+ protected:
+  void InitWeight(NDArray *arr) override {
+    auto shape = arr->Shape();
+    /* fan_in = prod of non-leading dims (conv: I*kh*kw, fc: input width) */
+    float fan_in = 1.0f;
+    for (size_t i = 1; i < shape.size(); ++i) {
+      fan_in *= static_cast<float>(shape[i]);
+    }
+    float fan_out = static_cast<float>(shape.empty() ? 1 : shape[0]);
+    float s = std::sqrt(2.0f * magnitude_ / (fan_in + fan_out));
+    std::vector<mx_float> buf(arr->Size());
+    for (auto &v : buf) v = (NextUnit(&state_) * 2.0f - 1.0f) * s;
+    arr->SyncCopyFromCPU(buf);
+  }
+  float magnitude_;
+};
+
+/* ------------------------------------------------------------------ Metric */
+/* parity: reference cpp-package metric.h — streaming accuracy over
+ * (label, pred) batches. */
+class Accuracy {
+ public:
+  void Reset() { correct_ = total_ = 0; }
+  void Update(const NDArray &labels, const NDArray &preds) {
+    auto ls = labels.SyncCopyToCPU();
+    auto ps = preds.SyncCopyToCPU();
+    size_t classes = ps.size() / ls.size();
+    for (size_t r = 0; r < ls.size(); ++r) {
+      size_t best = 0;
+      for (size_t c = 1; c < classes; ++c) {
+        if (ps[r * classes + c] > ps[r * classes + best]) best = c;
+      }
+      correct_ += (static_cast<size_t>(ls[r]) == best) ? 1 : 0;
+      ++total_;
+    }
+  }
+  float Get() const {
+    return total_ == 0 ? 0.0f
+                       : static_cast<float>(correct_) / total_;
+  }
+
+ private:
+  size_t correct_ = 0, total_ = 0;
+};
 
 /* Forward-only inference (parity: cpp predict usage of MXPred*). */
 class Predictor {

@@ -517,8 +517,19 @@ class ImageRecordIter(mx_io.DataIter):
                  mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0,
                  resize=-1, preprocess_threads=4, prefetch_buffer=4,
                  round_batch=True, part_index=0, num_parts=1, seed=0,
-                 data_name="data", label_name="softmax_label", **kwargs):
+                 data_name="data", label_name="softmax_label",
+                 dtype="float32", **kwargs):
         super().__init__(batch_size)
+        # dtype="uint8" (parity: ImageRecordUInt8Iter, reference
+        # iter_image_recordio.cc:481): geometric augmentation only, pixels
+        # stay uint8 — 4x less host->device transfer, normalisation moves
+        # on-device (compose the model on a Cast+affine prologue)
+        self.dtype = np.dtype(dtype)
+        if self.dtype == np.uint8 and (mean_r or mean_g or mean_b
+                                       or std_r != 1.0 or std_g != 1.0
+                                       or std_b != 1.0 or scale != 1.0):
+            raise ValueError("dtype='uint8' emits raw pixels; apply "
+                             "mean/std/scale on-device instead")
         self.path_imgrec = path_imgrec
         self.path_imgidx = path_imgidx
         self.data_shape = tuple(data_shape)
@@ -582,13 +593,16 @@ class ImageRecordIter(mx_io.DataIter):
         arr = arr[y0:y0 + h, x0:x0 + w]
         if self.rand_mirror and self._rng.random() < 0.5:
             arr = arr[:, ::-1]
-        out = arr.astype(np.float32)
-        if self._mean is not None:
-            out = out - self._mean
-        if self._std is not None:
-            out = out / self._std
-        if self._scale != 1.0:
-            out = out * self._scale
+        if self.dtype == np.uint8:
+            out = np.ascontiguousarray(arr, np.uint8)
+        else:
+            out = arr.astype(np.float32)
+            if self._mean is not None:
+                out = out - self._mean
+            if self._std is not None:
+                out = out / self._std
+            if self._scale != 1.0:
+                out = out * self._scale
         label = np.asarray(header.label, np.float32).reshape(-1)
         return out.transpose(2, 0, 1), label[:self.label_width]
 
@@ -645,7 +659,7 @@ class ImageRecordIter(mx_io.DataIter):
                         pad_out = pad
                     else:
                         data = np.zeros((self.batch_size, c, h, w),
-                                        np.float32)
+                                        self.dtype)
                         label = np.zeros((self.batch_size,
                                           self.label_width), np.float32)
                         for i, (d, l) in enumerate(samples):

@@ -59,10 +59,12 @@ def residual_unit(data, num_filter, stride, dim_match, name, bottle_neck=True,
 
 
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True, bn_mom=0.9, workspace=256, memonger=False):
+           bottle_neck=True, bn_mom=0.9, workspace=256, memonger=False,
+           data=None):
     num_unit = len(units)
     assert num_unit == num_stages
-    data = sym.Variable(name="data")
+    if data is None:
+        data = sym.Variable(name="data")
     data = sym.BatchNorm(data=data, fix_gamma=True, eps=2e-5, momentum=bn_mom,
                          name="bn_data")
     (nchannel, height, width) = image_shape
@@ -139,4 +141,5 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape="3,224,224",
         units = units_map[num_layers]
     return resnet(units=units, num_stages=num_stages, filter_list=filter_list,
                   num_classes=num_classes, image_shape=image_shape,
-                  bottle_neck=bottle_neck, workspace=conv_workspace)
+                  bottle_neck=bottle_neck, workspace=conv_workspace,
+                  data=kwargs.get("data"))
