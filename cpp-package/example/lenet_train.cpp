@@ -9,6 +9,7 @@
  * PASS when the final train accuracy exceeds 0.9.
  */
 #include <cstdio>
+#include <cstdlib>
 #include <string>
 #include <vector>
 
@@ -115,7 +116,15 @@ int main(int argc, char **argv) {
       for (int i : learnable) {
         opt.Update(i, args[i], grads[i]);
       }
-      acc.Update(args[label_idx], exec.Outputs()[0]);
+      /* wrap-padded tail samples must not be scored twice */
+      int pad = it.GetPadNum();
+      NDArray out = exec.Outputs()[0];
+      NDArray lab = args[label_idx];
+      if (pad > 0) {
+        out = out.Slice(0, batch - pad);
+        lab = lab.Slice(0, batch - pad);
+      }
+      acc.Update(lab, out);
     }
     last = acc.Get();
     std::printf("epoch %d accuracy %.3f\n", epoch, last);

@@ -659,6 +659,10 @@ class Accuracy {
   void Update(const NDArray &labels, const NDArray &preds) {
     auto ls = labels.SyncCopyToCPU();
     auto ps = preds.SyncCopyToCPU();
+    if (ls.empty() || ps.size() < ls.size()) {
+      throw std::runtime_error(
+          "Accuracy::Update: need one prediction row per label");
+    }
     size_t classes = ps.size() / ls.size();
     for (size_t r = 0; r < ls.size(); ++r) {
       size_t best = 0;

@@ -559,7 +559,8 @@ class ImageRecordIter(mx_io.DataIter):
     @property
     def provide_data(self):
         return [mx_io.DataDesc(self.data_name,
-                               (self.batch_size,) + self.data_shape)]
+                               (self.batch_size,) + self.data_shape,
+                               dtype=self.dtype)]
 
     @property
     def provide_label(self):
