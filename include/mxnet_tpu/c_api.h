@@ -103,6 +103,11 @@ MXNET_DLL int MXNotifyShutdown();
 MXNET_DLL int MXRandomSeed(int seed);
 
 /* --------------------------------------------------------------- NDArray */
+/*! \brief create an uninitialised handle to pass as a mutate-output (a
+ *  kvstore pull target, an imperative-op output slot); reports ndim == 0
+ *  from MXNDArrayGetShape until a producer fills it (parity: reference
+ *  c_api.h:195-201) */
+MXNET_DLL int MXNDArrayCreateNone(NDArrayHandle *out);
 MXNET_DLL int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim,
                               int dev_type, int dev_id, int delay_alloc,
                               NDArrayHandle *out);

@@ -1,97 +1,131 @@
-"""Training callbacks (parity: reference python/mxnet/callback.py)."""
+"""Training-loop callbacks (parity: reference python/mxnet/callback.py).
+
+Two callback shapes exist, set by the Module/FeedForward fit contract:
+
+* batch-end callbacks receive a ``BatchEndParam`` namedtuple
+  (``epoch``, ``nbatch``, ``eval_metric``, ``locals``);
+* epoch-end callbacks receive ``(epoch, symbol, arg_params, aux_params)``.
+
+The implementations here are this repo's own: the throughput meter is a
+mark-and-measure rate counter built on ``time.perf_counter`` (monotonic;
+the reference used wall-clock ``time.time``), and log lines are emitted
+through a module logger rather than the root logger.
+"""
 from __future__ import annotations
 
 import logging
-import math
 import time
 
 __all__ = ["do_checkpoint", "module_checkpoint", "log_train_metric",
            "Speedometer", "ProgressBar"]
 
+_LOG = logging.getLogger(__name__)
+
+
+def _metric_pairs(metric):
+    """name/value pairs of an EvalMetric, or [] when there is none."""
+    return [] if metric is None else metric.get_name_value()
+
 
 def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Checkpoint the module every `period` epochs (parity: module_checkpoint)."""
-    period = int(max(1, period))
+    """Epoch-end callback that saves ``mod`` every ``period`` epochs.
 
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+    Parity: reference callback.py ``module_checkpoint``.
+    """
+    every = max(1, int(period))
 
-    return _callback
+    def save_module(epoch, sym=None, arg=None, aux=None):
+        done = epoch + 1
+        if done % every == 0:
+            mod.save_checkpoint(prefix, done, save_optimizer_states)
+
+    return save_module
 
 
 def do_checkpoint(prefix, period=1):
-    """Save symbol+params each `period` epochs (parity: do_checkpoint)."""
+    """Epoch-end callback that saves symbol + params every ``period`` epochs.
+
+    Parity: reference callback.py ``do_checkpoint``.
+    """
     from .model import save_checkpoint
-    period = int(max(1, period))
+    every = max(1, int(period))
 
-    def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+    def save_params(epoch, sym, arg, aux):
+        done = epoch + 1
+        if done % every == 0:
+            save_checkpoint(prefix, done, sym, arg, aux)
 
-    return _callback
+    return save_params
 
 
 def log_train_metric(period, auto_reset=False):
-    """Log evaluation metric every `period` batches (parity: log_train_metric)."""
+    """Batch-end callback that logs the training metric every ``period``
+    batches, optionally resetting it afterwards.
 
-    def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
+    Parity: reference callback.py ``log_train_metric``.
+    """
 
-    return _callback
+    def emit(param):
+        if param.nbatch % period != 0:
+            return
+        for name, value in _metric_pairs(param.eval_metric):
+            _LOG.info("epoch %d batch %d: train %s = %f",
+                      param.epoch, param.nbatch, name, value)
+        if auto_reset and param.eval_metric is not None:
+            param.eval_metric.reset()
+
+    return emit
 
 
 class Speedometer(object):
-    """Log throughput in samples/sec (parity: Speedometer)."""
+    """Batch-end callback that reports samples/sec every ``frequent``
+    batches (parity: reference callback.py ``Speedometer``).
+
+    Keeps a single (batch-index, clock) mark; each report measures the
+    span since the mark and re-arms.  A batch index that moves backwards
+    (a new epoch, or an iterator reset) drops the mark so the first span
+    of every epoch starts clean.
+    """
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self._mark = None  # (nbatch, perf_counter) of the last report
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / \
-                    (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    param.eval_metric.reset()
-                    for name, value in name_value:
-                        logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f "
-                                     "samples/sec\tTrain-%s=%f",
-                                     param.epoch, count, speed, name, value)
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
+        now = time.perf_counter()
+        n = param.nbatch
+        if self._mark is not None and n < self._mark[0]:
+            self._mark = None
+        if self._mark is None:
+            self._mark = (n, now)
+            return
+        if n % self.frequent != 0 or n == self._mark[0]:
+            return
+        span = max(now - self._mark[1], 1e-12)
+        rate = (n - self._mark[0]) * self.batch_size / span
+        pairs = _metric_pairs(param.eval_metric)
+        if pairs:
+            param.eval_metric.reset()
+            shown = "  ".join("train-%s=%f" % nv for nv in pairs)
+            _LOG.info("Epoch[%d] Batch[%d]  %.2f samples/s  %s",
+                      param.epoch, n, rate, shown)
         else:
-            self.init = True
-            self.tic = time.time()
+            _LOG.info("Epoch[%d] Batch[%d]  %.2f samples/s",
+                      param.epoch, n, rate)
+        self._mark = (n, now)
 
 
 class ProgressBar(object):
-    """ASCII progress bar (parity: ProgressBar)."""
+    """Batch-end callback that renders an ASCII progress bar over ``total``
+    batches (parity: reference callback.py ``ProgressBar``)."""
 
     def __init__(self, total, length=80):
-        self.bar_len = length
         self.total = total
+        self.length = length
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+        frac = min(max(param.nbatch / float(self.total), 0.0), 1.0)
+        fill = int(round(self.length * frac))
+        bar = "#" * fill + "." * (self.length - fill)
+        _LOG.info("|%s| %3d%%", bar, int(frac * 100 + 0.5))

@@ -33,6 +33,15 @@ def nd_create(shape, dev_type, dev_id):
     return nd.zeros(tuple(int(x) for x in shape), ctx=_ctx(dev_type, dev_id))
 
 
+def nd_create_none():
+    """Uninitialised handle (parity: reference c_api.h:201
+    MXNDArrayCreateNone) — a 0-d placeholder whose value a later producer
+    (kvstore pull, imperative-op output, copy) replaces wholesale via
+    _set_value; MXNDArrayGetShape reports ndim == 0 until then, matching
+    the reference's empty-NDArray signature."""
+    return nd.zeros(())
+
+
 def nd_from_bytes(data, shape, dev_type, dev_id):
     arr = _np.frombuffer(data, dtype="<f4").reshape(
         tuple(int(x) for x in shape))

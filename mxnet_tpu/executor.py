@@ -82,6 +82,46 @@ class _Lowered(object):
                     == "relu":
                 self.fused_relu[id(n)] = act
         self._init_norm_conv(consumers, outs)
+        # peephole: train-mode BatchNorm(fix_gamma) applied directly to a
+        # graph input and consumed by exactly one Convolution (the ResNet
+        # "bn_data -> conv0" stem) fuses to ops/nn.py input_bn_conv, whose
+        # backward computes d(beta) without the backward-data convolution
+        # into the C-channel input grid (~14% of the b32 train step; see
+        # docs/perf.md).  Fires at run time only when the executor declares
+        # the input variable gradient-free.
+        self.stem_fuse = {}
+        for b in self.order:
+            if b.is_var or b.op.name != "BatchNorm":
+                continue
+            a = b.op.normalize_attrs(b.params)
+            if (not a.get("fix_gamma", True) or a.get("output_mean_var")
+                    or a.get("use_global_stats")
+                    or a.get("layout") not in (None, "NCHW")):
+                continue
+            src, si = b.inputs[0]
+            if not src.is_var or si != 0 or (id(b), 0) in outs:
+                continue
+            cons = consumers.get((id(b), 0), [])
+            if len(cons) != 1 or cons[0].is_var:
+                continue
+            conv = cons[0]
+            if conv.op.name != "Convolution" or conv.inputs[0] != (b, 0):
+                continue
+            ca = conv.op.normalize_attrs(conv.params)
+            kernel = tuple(ca.get("kernel") or ())
+            dilate = tuple(ca.get("dilate") or ()) or (1,) * len(kernel)
+            if (len(kernel) != 2 or not ca.get("no_bias")
+                    or int(ca.get("num_group") or 1) != 1
+                    or any(d != 1 for d in dilate)
+                    or ca.get("layout") not in (None, "NCHW")):
+                continue
+            self.stem_fuse[id(b)] = {
+                "var": src.name, "conv": conv,
+                "eps": float(a.get("eps", 1e-3)),
+                "momentum": float(a.get("momentum", 0.9)),
+                "kernel": kernel,
+                "stride": tuple(ca.get("stride") or ()) or (1, 1),
+                "pad": tuple(ca.get("pad") or ()) or (0, 0)}
 
     @staticmethod
     def _nc_conv_attrs(n):
@@ -250,7 +290,43 @@ class _Lowered(object):
             values[(id(node), 1)] = s
             values[(id(node), 2)] = q
 
-    def run(self, arg_vals, aux_vals, rng, is_train, collect=False):
+    def _stem_run(self, node, values, nhwc, aux_updates, skip, arg_vals):
+        """Run a fused input-BN + conv pair (see stem_fuse in __init__)."""
+        import jax.numpy as jnp
+        from .ops.nn import input_bn_conv
+        info = self.stem_fuse[id(node)]
+        xk = (id(node.inputs[0][0]), node.inputs[0][1])
+        x = values[xk]
+        if not hasattr(x, "ndim") or x.ndim != 4:
+            return False
+        x_cl = x if xk in nhwc else jnp.moveaxis(x, 1, -1)
+        conv = info["conv"]
+        beta = values[(id(node.inputs[2][0]), node.inputs[2][1])]
+        # the conv's weight variable sits after the BN in topo order — its
+        # values[] entry does not exist yet; resolve it from the arguments
+        wvar = conv.inputs[1][0]
+        w = values.get((id(wvar), conv.inputs[1][1]))
+        if w is None:
+            if not wvar.is_var or wvar.name not in arg_vals:
+                return False
+            w = arg_vals[wvar.name]
+        out, mean, var = input_bn_conv(x_cl, beta, w, info["eps"],
+                                       info["kernel"], info["stride"],
+                                       info["pad"])
+        mom = jnp.float32(info["momentum"])
+        for pos, stat in ((3, mean), (4, var)):
+            child = node.inputs[pos][0]
+            if child.is_var:
+                prev = values[(id(child), 0)]
+                aux_updates[child.name] = prev * mom + \
+                    stat.astype(prev.dtype) * (1 - mom)
+        values[(id(conv), 0)] = out
+        nhwc.add((id(conv), 0))
+        skip.add(id(conv))
+        return True
+
+    def run(self, arg_vals, aux_vals, rng, is_train, collect=False,
+            no_grad_inputs=()):
         """Trace the graph: dict name->array in, (outputs, aux_updates) out.
         With collect=True also returns {internal_name: value} for every op
         output — the monitor's data, gathered from the ONE real execution.
@@ -276,6 +352,9 @@ class _Lowered(object):
         # bisection); flip with MXNET_NORM_CONV=1 (+ MXNET_PALLAS_CONV).
         nc_on = (use_nhwc and not collect and bool(self.nc_bn)
                  and get_env("MXNET_NORM_CONV", "0") == "1")
+        stem_on = (use_nhwc and is_train and not collect
+                   and bool(self.stem_fuse) and no_grad_inputs
+                   and get_env("MXNET_STEM_FUSE", "1") == "1")
         nc_pl = get_env("MXNET_PALLAS_CONV", "auto")
         nc_ctx = {}
         values = {}
@@ -304,6 +383,14 @@ class _Lowered(object):
                 continue
             if id(node) in skip:
                 continue
+            if stem_on and id(node) in self.stem_fuse \
+                    and self.stem_fuse[id(node)]["var"] in no_grad_inputs \
+                    and not (nc_on and (id(node) in self.nc_bn or
+                                        id(self.stem_fuse[id(node)]["conv"])
+                                        in self.nc_conv)):
+                if self._stem_run(node, values, nhwc, aux_updates, skip,
+                                  arg_vals):
+                    continue
             if nc_on and id(node) in self.nc_bn:
                 if self._nc_run_bn(node, values, nhwc, aux_updates, nc_ctx,
                                    is_train, skip):
@@ -578,6 +665,7 @@ class Executor(object):
                      get_env("MXNET_CONV_LAYOUT", "NHWC"),
                      # NormConv fusion flags are also read at trace time
                      get_env("MXNET_NORM_CONV", "0"),
+                     get_env("MXNET_STEM_FUSE", "1"),
                      get_env("MXNET_PALLAS_CONV", "auto"))
         fn = self._jit_cache.get(cache_key)
         if fn is not None:
@@ -620,7 +708,8 @@ class Executor(object):
             def f(gargs, oargs, aux, rng):
                 all_args = dict(oargs)
                 all_args.update(gargs)
-                res = low.run(all_args, aux, rng, True, collect=collect)
+                res = low.run(all_args, aux, rng, True, collect=collect,
+                              no_grad_inputs=frozenset(oargs))
                 outs, aux_upd = res[0], res[1]
                 coll = res[2] if collect else {}
                 return tuple(outs), (aux_upd, coll)

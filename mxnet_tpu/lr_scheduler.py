@@ -1,76 +1,124 @@
-"""Learning-rate schedulers (parity: reference python/mxnet/lr_scheduler.py)."""
+"""Learning-rate schedules (parity: reference python/mxnet/lr_scheduler.py).
+
+Design note: unlike the reference, which walks an internal counter forward
+and mutates ``base_lr`` in place on every call, these schedulers are pure
+functions of ``num_update`` — the decayed rate is recomputed arithmetically
+each call.  That makes them safe under the fused ``TrainStep`` path, where
+``num_update`` can jump by a whole scan-chunk between host-side calls, and
+under replay/rewind (checkpoint resume re-queries an earlier step without
+stale internal state).  ``base_lr`` remains a plain attribute because the
+Optimizer contract assigns it after construction.
+"""
 from __future__ import annotations
 
 import logging
 
 __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler"]
 
+_LOG = logging.getLogger(__name__)
+
 
 class LRScheduler(object):
-    """Base scheduler: maps num_update -> lr."""
+    """Maps an update count to a learning rate.
+
+    Subclasses implement ``__call__(num_update) -> float``.  ``num_update``
+    is the number of optimizer updates applied so far (the fused path passes
+    the scan-step counter).
+    """
 
     def __init__(self, base_lr=0.01):
         self.base_lr = base_lr
 
     def __call__(self, num_update):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            "%s does not implement __call__" % type(self).__name__)
 
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every `step` updates (parity: lr_scheduler.py:36)."""
+    """Geometric decay: multiply the rate by ``factor`` every ``step``
+    updates, never dropping below ``stop_factor_lr``.
+
+    Parity: reference lr_scheduler.py:36 (same decay boundaries: the k-th
+    decay takes effect at num_update == k*step + 1).
+    """
 
     def __init__(self, step, factor=1, stop_factor_lr=1e-8):
         super().__init__()
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1")
+            raise ValueError(
+                "FactorScheduler: step was %r; need a positive update "
+                "interval" % (step,))
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError(
+                "FactorScheduler: factor was %r; a decay factor cannot "
+                "exceed 1" % (factor,))
         self.step = step
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
+        self._last_logged = 0
+
+    def _decays_at(self, num_update):
+        # num_update in [k*step+1, (k+1)*step] has had k decays applied
+        return max(0, int(num_update) - 1) // self.step
 
     def __call__(self, num_update):
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-                logging.info("Update[%d]: now learning rate arrived at %0.5e, "
-                             "will not change in the future", num_update,
-                             self.base_lr)
+        k = self._decays_at(num_update)
+        lr = self.base_lr * (self.factor ** k)
+        floored = lr < self.stop_factor_lr
+        lr = max(lr, self.stop_factor_lr)
+        if k != self._last_logged:
+            self._last_logged = k
+            if floored:
+                _LOG.info("lr schedule: floor %.5e reached at update %d; "
+                          "holding there", lr, num_update)
             else:
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-        return self.base_lr
+                _LOG.info("lr schedule: %.5e after %d decay(s) "
+                          "(update %d)", lr, k, num_update)
+        return lr
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at each step in a list (parity: lr_scheduler.py:73)."""
+    """Piecewise-constant decay: multiply the rate by ``factor`` once at
+    each boundary in ``step`` (a strictly increasing list of update counts).
+
+    Parity: reference lr_scheduler.py:73 (a boundary ``b`` takes effect at
+    num_update == b + 1).
+    """
 
     def __init__(self, step, factor=1):
         super().__init__()
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1")
+        if not isinstance(step, list) or not step:
+            raise ValueError(
+                "MultiFactorScheduler: step must be a non-empty list of "
+                "update counts, got %r" % (step,))
+        prev = 0
+        for b in step:
+            if b < 1:
+                raise ValueError(
+                    "MultiFactorScheduler: boundary %r is not a positive "
+                    "update count" % (b,))
+            if b <= prev:
+                raise ValueError(
+                    "MultiFactorScheduler: boundaries must be strictly "
+                    "increasing, got %r" % (step,))
+            prev = b
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError(
+                "MultiFactorScheduler: factor was %r; a decay factor "
+                "cannot exceed 1" % (factor,))
         self.step = step
-        self.cur_step_ind = 0
         self.factor = factor
-        self.count = 0
+        self._last_logged = 0
+
+    def _decays_at(self, num_update):
+        # count of boundaries already crossed (crossing happens at b+1)
+        return sum(1 for b in self.step if num_update > b)
 
     def __call__(self, num_update):
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-            else:
-                return self.base_lr
-        return self.base_lr
+        k = self._decays_at(num_update)
+        lr = self.base_lr * (self.factor ** k)
+        if k != self._last_logged:
+            self._last_logged = k
+            _LOG.info("lr schedule: %.5e after boundary %d of %d "
+                      "(update %d)", lr, k, len(self.step), num_update)
+        return lr

@@ -1,211 +1,243 @@
-"""SequentialModule — chain modules (parity: reference
-python/mxnet/module/sequential_module.py)."""
+"""SequentialModule — run a list of Modules as one pipeline (parity:
+reference python/mxnet/module/sequential_module.py).
+
+Each stage is a full Module; stage i+1's data is stage i's outputs.  The
+chain trains by stepping every stage's own executor/optimizer, with
+gradients handed backwards through ``get_input_grads`` — the same contract
+the reference implements, but stored here as explicit per-stage records
+instead of META_* attribute introspection.
+"""
 from __future__ import annotations
 
 import logging
 
-from ..base import MXNetError
 from .base_module import BaseModule
 
 __all__ = ["SequentialModule"]
 
 
+class _Stage(object):
+    """One link of the chain plus its wiring options."""
+
+    __slots__ = ("module", "takes_labels", "rewire")
+
+    def __init__(self, module, takes_labels, rewire):
+        self.module = module
+        self.takes_labels = takes_labels
+        self.rewire = rewire
+
+
 class SequentialModule(BaseModule):
+    """Chain modules sequentially.
+
+    ``add(module, take_labels=..., auto_wiring=...)`` appends a stage:
+
+    * ``take_labels`` — this stage's symbol consumes the loss labels
+      (typically only the last stage);
+    * ``auto_wiring`` — rename the incoming data shapes to this stage's
+      own ``data_names`` so independently-built symbols connect.
+    """
+
+    # public option-name constants (parity: reference META_* attributes)
     META_TAKE_LABELS = "take_labels"
     META_AUTO_WIRING = "auto_wiring"
+    _STAGE_OPTIONS = frozenset((META_TAKE_LABELS, META_AUTO_WIRING))
 
     def __init__(self, logger=logging):
         super().__init__(logger=logger)
-        self._modules = []
-        self._metas = []
-        self._label_shapes = None
-        self._data_shapes = None
-        self._meta_keys = set([getattr(SequentialModule, x)
-                               for x in dir(SequentialModule)
-                               if x.startswith("META_")])
+        self._stages = []
+        self._bound_label_shapes = None
 
-    def add(self, module, **kwargs):
-        """Append a module; meta keys: take_labels, auto_wiring (parity: add)."""
-        self._modules.append(module)
-        for key in kwargs:
-            assert key in self._meta_keys, "Unknown meta \"%s\"" % key
-        self._metas.append(kwargs)
+    def add(self, module, **options):
+        """Append a stage; unknown option names are rejected."""
+        bad = set(options) - self._STAGE_OPTIONS
+        if bad:
+            raise TypeError(
+                "SequentialModule.add: unsupported option(s) %s; valid "
+                "options are %s" % (sorted(bad), sorted(self._STAGE_OPTIONS)))
+        self._stages.append(_Stage(
+            module,
+            takes_labels=bool(options.get(self.META_TAKE_LABELS, False)),
+            rewire=bool(options.get(self.META_AUTO_WIRING, False))))
+        # the chain changed shape: every derived state is stale
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
         return self
 
+    # ------------------------------------------------------------ properties
+    @property
+    def _modules(self):
+        # convenience view (kept for introspection parity with the
+        # reference attribute of the same name)
+        return [s.module for s in self._stages]
+
     @property
     def data_names(self):
-        if len(self._modules) > 0:
-            return self._modules[0].data_names
-        return []
+        return self._stages[0].module.data_names if self._stages else []
 
     @property
     def output_names(self):
-        if len(self._modules) > 0:
-            return self._modules[-1].output_names
-        return []
+        return self._stages[-1].module.output_names if self._stages else []
 
     @property
     def data_shapes(self):
         assert self.binded
-        return self._modules[0].data_shapes
+        return self._stages[0].module.data_shapes
 
     @property
     def label_shapes(self):
         assert self.binded
-        return self._label_shapes
+        return self._bound_label_shapes
 
     @property
     def output_shapes(self):
         assert self.binded
-        return self._modules[-1].output_shapes
+        return self._stages[-1].module.output_shapes
 
+    # ------------------------------------------------------------ parameters
     def get_params(self):
         assert self.binded and self.params_initialized
-        arg_params = dict()
-        aux_params = dict()
-        for module in self._modules:
-            arg, aux = module.get_params()
-            arg_params.update(arg)
-            aux_params.update(aux)
-        return (arg_params, aux_params)
+        args, auxs = {}, {}
+        for stage in self._stages:
+            a, x = stage.module.get_params()
+            args.update(a)
+            auxs.update(x)
+        return args, auxs
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False):
         if self.params_initialized and not force_init:
             return
-        assert self.binded, "call bind before initializing the parameters"
-        for module in self._modules:
-            module.init_params(initializer=initializer, arg_params=arg_params,
-                               aux_params=aux_params,
-                               allow_missing=allow_missing,
-                               force_init=force_init)
-
-        def _check_name(known_names, new_names, modules, i):
-            for name in new_names:
-                assert not name in known_names, "Duplicated parameter names: " \
-                    "name \"%s\" in layer %d (%s) is already used in layer %d " \
-                    "(%s)." % (name, i, type(modules[i]),
-                               known_names[name],
-                               type(modules[known_names[name]]))
-                known_names[name] = i
-
-        arg_names = dict()
-        aux_names = dict()
-        for i_layer, module in enumerate(self._modules):
-            arg_params, aux_params = module.get_params()
-            _check_name(arg_names, arg_params.keys(), self._modules, i_layer)
-            _check_name(aux_names, aux_params.keys(), self._modules, i_layer)
+        assert self.binded, "bind the chain before init_params"
+        for stage in self._stages:
+            stage.module.init_params(
+                initializer=initializer, arg_params=arg_params,
+                aux_params=aux_params, allow_missing=allow_missing,
+                force_init=force_init)
+        self._reject_shadowed_params()
         self.params_initialized = True
 
+    def _reject_shadowed_params(self):
+        """A name appearing in two stages would silently train two copies."""
+        owner = {}
+        for i, stage in enumerate(self._stages):
+            for group in stage.module.get_params():
+                for name in group:
+                    if name in owner:
+                        raise ValueError(
+                            "parameter %r exists in both stage %d and "
+                            "stage %d of the chain; give the layers "
+                            "distinct name prefixes" % (name, owner[name], i))
+                    owner[name] = i
+
+    # ------------------------------------------------------------------ bind
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
-        """Bind the chain, wiring each module's outputs to the next's data
-        (parity: sequential_module.bind)."""
+        """Bind every stage, threading output shapes into the next stage's
+        data shapes (parity: reference sequential bind)."""
         if self.binded and not force_rebind:
-            self.logger.warning("Already binded, ignoring bind()")
+            self.logger.warning("SequentialModule: already bound; pass "
+                                "force_rebind=True to rebind")
             return
         if inputs_need_grad:
             assert for_training
-        assert shared_module is None, "Shared module is not supported"
-        assert len(self._modules) > 0, "Attempting to bind an empty " \
-            "SequentialModule"
-        self.binded = True
+        assert shared_module is None, \
+            "SequentialModule does not support shared_module"
+        assert self._stages, "cannot bind a chain with no stages"
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
-        self._label_shapes = label_shapes
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i_layer, module in enumerate(self._modules):
-            meta = self._metas[i_layer]
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
-            my_inputs_need_grad = bool(for_training and (
-                inputs_need_grad or i_layer > 0))
-            if meta.get(SequentialModule.META_AUTO_WIRING, False):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [(new_name, shape) for (new_name, (_, shape))
-                                  in zip(data_names, my_data_shapes)]
-            module.bind(data_shapes=my_data_shapes,
-                        label_shapes=my_label_shapes,
-                        for_training=for_training,
-                        inputs_need_grad=my_inputs_need_grad,
-                        force_rebind=force_rebind, shared_module=None,
-                        grad_req=grad_req)
-            my_data_shapes = module.output_shapes
-        if not anybody_ever_needs_label:
-            self._label_shapes = None
+        incoming = data_shapes
+        labels_used = False
+        for i, stage in enumerate(self._stages):
+            if stage.rewire:
+                names = stage.module.data_names
+                assert len(names) == len(incoming), (
+                    "auto_wiring: stage %d expects %d inputs, got %d"
+                    % (i, len(names), len(incoming)))
+                incoming = [(name, shape) for name, (_, shape)
+                            in zip(names, incoming)]
+            stage.module.bind(
+                data_shapes=incoming,
+                label_shapes=label_shapes if stage.takes_labels else None,
+                for_training=for_training,
+                # interior stages always need input grads to keep the
+                # backward chain flowing; the first follows the caller
+                inputs_need_grad=bool(for_training
+                                      and (inputs_need_grad or i > 0)),
+                force_rebind=force_rebind, shared_module=None,
+                grad_req=grad_req)
+            labels_used = labels_used or stage.takes_labels
+            incoming = stage.module.output_shapes
+        self._bound_label_shapes = label_shapes if labels_used else None
+        self.binded = True
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
-            self.logger.warning("optimizer already initialized, ignoring.")
+            self.logger.warning("SequentialModule: optimizer already "
+                                "initialized; ignoring")
             return
-        for module in self._modules:
-            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                                  optimizer_params=optimizer_params,
-                                  force_init=force_init)
+        for stage in self._stages:
+            stage.module.init_optimizer(
+                kvstore=kvstore, optimizer=optimizer,
+                optimizer_params=optimizer_params, force_init=force_init)
         self.optimizer_initialized = True
 
+    # -------------------------------------------------------------- stepping
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         from ..io import DataBatch
-        data_batch = DataBatch(data=data_batch.data, label=data_batch.label,
-                               pad=data_batch.pad, index=data_batch.index,
-                               provide_data=data_batch.provide_data,
-                               provide_label=data_batch.provide_label)
-        for i_layer, module in enumerate(self._modules):
-            module.forward(data_batch, is_train=is_train)
-            if i_layer + 1 == len(self._modules):
+        # work on a copy: threading outputs through must not mutate the
+        # caller's batch object
+        flowing = DataBatch(data=data_batch.data, label=data_batch.label,
+                            pad=data_batch.pad, index=data_batch.index,
+                            provide_data=data_batch.provide_data,
+                            provide_label=data_batch.provide_label)
+        last = len(self._stages) - 1
+        for i, stage in enumerate(self._stages):
+            stage.module.forward(flowing, is_train=is_train)
+            if i == last:
                 break
-            data_batch.data = module.get_outputs()
-            if hasattr(data_batch, "provide_data"):
-                data_batch.provide_data = [
-                    (name, x.shape) for name, x in
-                    zip(module.output_names, module.get_outputs())]
+            outs = stage.module.get_outputs()
+            flowing.data = outs
+            flowing.provide_data = [
+                (name, out.shape)
+                for name, out in zip(stage.module.output_names, outs)]
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        for i_layer, module in reversed(list(zip(range(len(self._modules)),
-                                                 self._modules))):
-            module.backward(out_grads=out_grads)
-            if i_layer == 0:
-                break
-            out_grads = module.get_input_grads()
+        for i in range(len(self._stages) - 1, -1, -1):
+            stage = self._stages[i]
+            stage.module.backward(out_grads=out_grads)
+            if i:
+                out_grads = stage.module.get_input_grads()
 
     def update(self):
-        assert self.binded and self.params_initialized and \
-            self.optimizer_initialized
-        for module in self._modules:
-            module.update()
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        for stage in self._stages:
+            stage.module.update()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._modules[-1].get_outputs(merge_multi_context)
+        return self._stages[-1].module.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized and \
-            self.inputs_need_grad
-        return self._modules[0].get_input_grads(merge_multi_context)
+        assert self.binded and self.params_initialized \
+            and self.inputs_need_grad
+        return self._stages[0].module.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
-        for meta, module in zip(self._metas, self._modules):
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                module.update_metric(eval_metric, labels)
+        for stage in self._stages:
+            if stage.takes_labels:
+                stage.module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
-        for module in self._modules:
-            module.install_monitor(mon)
+        for stage in self._stages:
+            stage.module.install_monitor(mon)

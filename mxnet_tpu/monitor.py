@@ -1,81 +1,109 @@
-"""Monitor — per-op output statistics (parity: reference
-python/mxnet/monitor.py:16-126)."""
+"""Monitor — per-tensor statistics of a training step (parity: reference
+python/mxnet/monitor.py:16-126).
+
+Lifecycle, set by the Module.fit contract: ``install(executor)`` hooks the
+executor's monitor callback; ``tic()`` arms collection for the batches where
+``step % interval == 0``; the executor streams (name, array) pairs into the
+armed monitor during forward; ``toc()`` adds a snapshot of the executor's
+argument arrays, disarms, and returns ``(step, tensor_name, stat_string)``
+rows.  Under this repo's executor the callback fires from the ONE jitted
+execution (executor.py's monitor path), not from per-op kernel dispatch.
+"""
 from __future__ import annotations
 
 import logging
+import math
 import re
-from math import sqrt
 
 from . import ndarray as nd
 from .ndarray import NDArray
 
 __all__ = ["Monitor"]
 
+_LOG = logging.getLogger(__name__)
+
+
+def _rms(x):
+    """Default statistic: RMS magnitude of the tensor (norm / sqrt(size))."""
+    return nd.norm(x) / math.sqrt(x.size)
+
+
+def _render(stat):
+    """A stat result (NDArray, number, or list of either) -> display string."""
+    items = stat if isinstance(stat, list) else [stat]
+    return ",".join(
+        str(v.asnumpy()) if isinstance(v, NDArray) else str(v)
+        for v in items)
+
 
 class Monitor(object):
-    """Collect per-op output stats via the executor monitor callback."""
+    """Collects per-tensor statistics every ``interval`` batches.
+
+    Parameters
+    ----------
+    interval : arm collection once every this many ``tic()`` calls
+    stat_func : NDArray -> NDArray/number/list; default RMS magnitude
+    pattern : regex — only tensor names matching it are recorded
+    sort : sort the rows of each ``toc()`` by tensor name
+    """
 
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-            def asum_stat(x):
-                return nd.norm(x) / sqrt(x.size)
-            stat_func = asum_stat
-        self.stat_func = stat_func
         self.interval = interval
-        self.activated = False
-        self.queue = []
-        self.step = 0
-        self.exes = []
-        self.re_prog = re.compile(pattern)
+        self.stat_func = stat_func if stat_func is not None else _rms
         self.sort = sort
+        self._name_ok = re.compile(pattern).match
+        self._armed = False
+        self._step = 0
+        self._rows = []          # (step, tensor name, raw stat)
+        self._installed = []     # executors hooked via install()
+        # public alias: executors are handed this callable via install()
+        self.stat_helper = self._observe
 
-        def stat_helper(name, array):
-            if not self.activated or not self.re_prog.match(name):
-                return
-            self.queue.append((self.step, name, self.stat_func(array)))
-
-        self.stat_helper = stat_helper
+    def _observe(self, name, array):
+        """Executor callback: record one tensor if armed and name matches."""
+        if self._armed and self._name_ok(name):
+            self._rows.append((self._step, name, self.stat_func(array)))
 
     def install(self, exe):
-        """(parity: Monitor.install via set_monitor_callback)"""
+        """Hook an executor (parity: Monitor.install / set_monitor_callback)."""
         exe.set_monitor_callback(self.stat_helper)
-        self.exes.append(exe)
+        self._installed.append(exe)
 
-    def tic(self):
-        if self.step % self.interval == 0:
-            for exe in self.exes:
-                for array in exe.arg_arrays:
-                    array.wait_to_read()
-            self.queue = []
-            self.activated = True
-        self.step += 1
-
-    def toc(self):
-        if not self.activated:
-            return []
-        for exe in self.exes:
+    def _drain_pending(self):
+        """Finish any in-flight executor work so stats read settled values."""
+        for exe in self._installed:
             for array in exe.arg_arrays:
                 array.wait_to_read()
-        for exe in self.exes:
+
+    def tic(self):
+        """Begin a batch; arms collection on the interval boundary."""
+        if self._step % self.interval == 0:
+            self._drain_pending()
+            self._rows = []
+            self._armed = True
+        self._step += 1
+
+    def toc(self):
+        """End an armed batch: snapshot argument arrays of every installed
+        executor, disarm, and return the collected rows as
+        ``(step, name, stat_string)`` tuples."""
+        if not self._armed:
+            return []
+        self._drain_pending()
+        for exe in self._installed:
             for name, array in zip(exe._symbol.list_arguments(),
                                    exe.arg_arrays):
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
-        self.activated = False
-        res = []
+                if self._name_ok(name):
+                    self._rows.append((self._step, name,
+                                       self.stat_func(array)))
+        self._armed = False
+        rows = self._rows
+        self._rows = []
         if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            assert isinstance(v_list, list)
-            s = ",".join(str(v.asnumpy() if isinstance(v, NDArray) else v)
-                         for v in v_list)
-            res.append((n, k, s))
-        self.queue = []
-        return res
+            rows.sort(key=lambda row: row[1])
+        return [(step, name, _render(stat)) for step, name, stat in rows]
 
     def toc_print(self):
-        res = self.toc()
-        for n, k, v in res:
-            logging.info("Batch: %7d %30s %s", n, k, v)
+        """``toc()`` + log each row (parity: Monitor.toc_print)."""
+        for step, name, shown in self.toc():
+            _LOG.info("Batch: %7d %30s %s", step, name, shown)

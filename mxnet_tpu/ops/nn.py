@@ -514,6 +514,121 @@ def _bn_relu_train_core_bwd(eps, caxis, res, cts):
 _bn_relu_train_core.defvjp(_bn_relu_train_core_fwd, _bn_relu_train_core_bwd)
 
 
+# ------------------------------------------------- fused input-BN + stem conv
+def _ibc_fwd_impl(x, b, w, eps, geom):
+    """Forward of the fused input BatchNorm(fix_gamma) + Convolution.
+
+    ``x`` channel-last (N, H, W, C); ``w`` logical (O, C, kh, kw).
+    Returns (conv_out_cl, mean, var, inv)."""
+    k, s, p = geom
+    axes, cshape = _bn_axes(x.ndim, -1)
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    x32 = x.astype(acc)
+    mean = jnp.mean(x32, axis=axes)
+    var = jnp.maximum(jnp.mean(jnp.square(x32), axis=axes)
+                      - jnp.square(mean), 0.0)
+    inv = jax.lax.rsqrt(var + eps)
+    shift = b.astype(acc) - mean * inv
+    y = x * inv.reshape(cshape).astype(x.dtype) \
+        + shift.reshape(cshape).astype(x.dtype)
+    out = jax.lax.conv_general_dilated(
+        y, jnp.transpose(w, (2, 3, 1, 0)), window_strides=s,
+        padding=[(pp, pp) for pp in p],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return out, mean, var, inv
+
+
+def _ibc_tap_ranges(in_dim, out_dim, k, s, p):
+    """Per-tap inclusive output-index range whose input taps stay in-bounds:
+    tap ``t`` at output ``i`` touches input row ``s*i - p + t``."""
+    ranges = []
+    for t in range(k):
+        lo = max(0, -((-(p - t)) // s))   # ceil((p - t) / s), clamped
+        hi = min(out_dim - 1, (in_dim - 1 + p - t) // s)
+        ranges.append((lo, hi))
+    return ranges
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _input_bn_conv_core(x, b, w, eps, geom):
+    """BatchNorm(train, fix_gamma) on a no-gradient input, fused with the
+    consuming Convolution — the ResNet stem pattern (bn_data -> conv0,
+    reference example/image-classification/symbol_resnet.py).
+
+    The only gradients this pattern needs are d(weight) and d(beta); the
+    naive backward nevertheless runs a full backward-data convolution into
+    the C-channel input grid purely to reduce it to d(beta) = sum(dy) — on
+    TPU that dgrad runs at ~4% MXU efficiency (output channels = C = 3 pad
+    to the 128-lane MXU).  This VJP computes d(beta) exactly without it:
+    summing the transposed conv over the whole input grid collapses, per
+    kernel tap, to a rectangle sum of the incoming cotangent over the
+    output positions whose tap stays in-bounds — 2D prefix sums give every
+    rectangle in one cheap pass, and a tiny einsum with the weights
+    finishes the reduction.  d(x) is NOT produced (hard zero): the
+    executor only fuses this pattern when the input is declared
+    no-gradient."""
+    out, mean, var, _ = _ibc_fwd_impl(x, b, w, eps, geom)
+    return out, mean, var
+
+
+def _input_bn_conv_fwd(x, b, w, eps, geom):
+    out, mean, var, inv = _ibc_fwd_impl(x, b, w, eps, geom)
+    return (out, mean, var), (x, b, w, mean, inv)
+
+
+def _input_bn_conv_bwd(eps, geom, res, cts):
+    g, _dmean_ct, _dvar_ct = cts      # mean/var flow only to x (dropped)
+    x, b, w, mean, inv = res
+    k, s, p = geom
+    _, cshape = _bn_axes(x.ndim, -1)
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    # d(weight): standard wgrad with the normalised input recomputed (the
+    # per-channel scale/shift fuses into the wgrad conv's input read)
+    shift = b.astype(acc) - mean * inv
+    y = x * inv.reshape(cshape).astype(x.dtype) \
+        + shift.reshape(cshape).astype(x.dtype)
+
+    def conv_of_w(wt):
+        return jax.lax.conv_general_dilated(
+            y, jnp.transpose(wt, (2, 3, 1, 0)), window_strides=s,
+            padding=[(pp, pp) for pp in p],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    _, w_vjp = jax.vjp(conv_of_w, w)
+    dw = w_vjp(g)[0]
+    # d(beta) = sum over the input grid of dgrad(g, w), computed without the
+    # dgrad: per-tap rectangle sums of G = sum_n g via 2D prefix sums
+    G = jnp.sum(g.astype(acc), axis=0)              # (Ho, Wo, O)
+    P = jnp.pad(jnp.cumsum(jnp.cumsum(G, axis=0), axis=1),
+                ((1, 0), (1, 0), (0, 0)))           # (Ho+1, Wo+1, O)
+    in_h, in_w = x.shape[1], x.shape[2]
+    out_h, out_w = g.shape[1], g.shape[2]
+    rows = _ibc_tap_ranges(in_h, out_h, k[0], s[0], p[0])
+    cols = _ibc_tap_ranges(in_w, out_w, k[1], s[1], p[1])
+    taps = []
+    for r0, r1 in rows:
+        for c0, c1 in cols:
+            if r0 > r1 or c0 > c1:
+                taps.append(jnp.zeros((g.shape[3],), acc))
+                continue
+            taps.append(P[r1 + 1, c1 + 1] - P[r0, c1 + 1]
+                        - P[r1 + 1, c0] + P[r0, c0])
+    S = jnp.stack(taps).reshape(k[0], k[1], g.shape[3])   # (kh, kw, O)
+    db = jnp.einsum("ocij,ijo->c", w.astype(acc), S)
+    return jnp.zeros_like(x), db.astype(b.dtype), dw
+
+
+_input_bn_conv_core.defvjp(_input_bn_conv_fwd, _input_bn_conv_bwd)
+
+
+def input_bn_conv(x_cl, beta, weight, eps, kernel, stride, pad):
+    """Executor entry point: fused train-mode input-BN + conv, channel-last.
+    Returns (out_cl, mean, var) with mean/var in f32 for the moving-stat
+    update."""
+    geom = (tuple(int(v) for v in kernel), tuple(int(v) for v in stride),
+            tuple(int(v) for v in pad))
+    return _input_bn_conv_core(x_cl, beta, weight, float(eps), geom)
+
+
 def _bn_infer(attrs, in_shapes):
     data = in_shapes[0]
     c = None if data is None else (data[1],)

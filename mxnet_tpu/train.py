@@ -259,7 +259,8 @@ class TrainStep(object):
                         for k, v in vals.items()}
                 params = {k: v.astype(dtype) for k, v in params.items()}
             vals.update(params)
-            outs, aux_upd = low.run(vals, aux, rng, True)
+            outs, aux_upd = low.run(vals, aux, rng, True,
+                                    no_grad_inputs=inputs)
             return tuple(outs), aux_upd
 
         if remat:

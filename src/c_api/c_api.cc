@@ -566,6 +566,14 @@ int MXRandomSeed(int seed) {
 }
 
 /* ----------------------------------------------------------------- NDArray */
+int MXNDArrayCreateNone(NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallShim("nd_create_none", nullptr);
+  CHECK_PY(r);
+  *out = r;  // keep the reference as the handle
+  API_END();
+}
+
 int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
                     int dev_id, int delay_alloc, NDArrayHandle *out) {
   (void)delay_alloc;  // XLA owns allocation; the hint is meaningless here

@@ -57,6 +57,44 @@ def test_ndarray_roundtrip(libmx):
     _check(libmx, libmx.MXNDArrayFree(handle))
 
 
+def test_ndarray_create_none_kvstore_pull(libmx):
+    """MXNDArrayCreateNone (parity: reference c_api.h:195-201): the handle
+    starts ndim == 0 and a kvstore pull fills it in — the reference's
+    deferred-output calling pattern."""
+    none_h = ctypes.c_void_p()
+    _check(libmx, libmx.MXNDArrayCreateNone(ctypes.byref(none_h)))
+    ndim = ctypes.c_uint(7)
+    pdata = ctypes.POINTER(ctypes.c_uint)()
+    _check(libmx, libmx.MXNDArrayGetShape(none_h, ctypes.byref(ndim),
+                                          ctypes.byref(pdata)))
+    assert ndim.value == 0
+
+    kv = ctypes.c_void_p()
+    _check(libmx, libmx.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    shape = (ctypes.c_uint * 1)(4)
+    src = ctypes.c_void_p()
+    _check(libmx, libmx.MXNDArrayCreate(shape, 1, 1, 0, 0,
+                                        ctypes.byref(src)))
+    data = np.arange(4, dtype=np.float32)
+    _check(libmx, libmx.MXNDArraySyncCopyFromCPU(
+        src, data.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(4)))
+    key = (ctypes.c_int * 1)(3)
+    _check(libmx, libmx.MXKVStoreInit(kv, 1, key,
+                                      (ctypes.c_void_p * 1)(src)))
+    _check(libmx, libmx.MXKVStorePull(kv, 1, key,
+                                      (ctypes.c_void_p * 1)(none_h), 0))
+    _check(libmx, libmx.MXNDArrayGetShape(none_h, ctypes.byref(ndim),
+                                          ctypes.byref(pdata)))
+    assert ndim.value == 1 and pdata[0] == 4
+    out = np.zeros(4, dtype=np.float32)
+    _check(libmx, libmx.MXNDArraySyncCopyToCPU(
+        none_h, out.ctypes.data_as(ctypes.c_void_p), ctypes.c_size_t(4)))
+    np.testing.assert_array_equal(out, data)
+    _check(libmx, libmx.MXNDArrayFree(none_h))
+    _check(libmx, libmx.MXNDArrayFree(src))
+    _check(libmx, libmx.MXKVStoreFree(kv))
+
+
 def test_ndarray_save_load(libmx, tmp_path):
     fname = str(tmp_path / "arrs.params").encode()
     shape = (ctypes.c_uint * 1)(5)
