@@ -100,18 +100,35 @@ def nd_load_from_raw_bytes(data):
 
 def nd_get_data_f32(handle):
     """Host f32 copy whose buffer the C side hands out as MXNDArrayGetData;
-    every copy ever handed out is stashed on the NDArray so each returned
-    pointer stays valid for the handle's whole lifetime (the header's
-    contract).  Read-only by nature — XLA arrays are immutable, so writes
-    through the pointer cannot propagate (the reference returns a mutable
-    CPU pointer; cpp-package only reads through it)."""
-    buf = _np.ascontiguousarray(
-        handle.asnumpy().astype("<f4", copy=False)).tobytes()
+    the copy is stashed on the NDArray so the returned pointer stays valid
+    for the handle's whole lifetime (the header's contract).  Re-polling an
+    UNCHANGED array reuses the stashed buffer (same pointer, no growth — a
+    weight polled every batch must not accumulate host copies); a mutated
+    array gets a fresh copy, and the superseded buffer is still retained
+    because a caller may hold its pointer.  Read-only by nature — XLA
+    arrays are immutable, so writes through the pointer cannot propagate
+    (the reference returns a mutable CPU pointer; cpp-package only reads
+    through it)."""
     refs = getattr(handle, "_c_data_ref", None)
     if refs is None:
         refs = []
         handle._c_data_ref = refs
-    refs.append(buf)
+    cur = handle.value
+    last = refs[-1] if refs else None
+    if last is not None and last[0]() is cur:
+        return last[1]
+    buf = _np.ascontiguousarray(
+        handle.asnumpy().astype("<f4", copy=False)).tobytes()
+    # weakref to the device array: the identity check needs it only while
+    # that array is alive anyway, and a strong ref would pin every
+    # superseded XLA buffer for the handle's lifetime (the bytes alone
+    # must stay — callers may hold the pointer)
+    import weakref
+    try:
+        wr = weakref.ref(cur)
+    except TypeError:
+        wr = lambda: None
+    refs.append((wr, buf))
     return buf
 
 

@@ -666,6 +666,8 @@ class Executor(object):
                      # NormConv fusion flags are also read at trace time
                      get_env("MXNET_NORM_CONV", "0"),
                      get_env("MXNET_STEM_FUSE", "1"),
+                     get_env("MXNET_STEM_S2D", "0"),
+                     get_env("MXNET_POOL_MASK_BWD", "0"),
                      get_env("MXNET_PALLAS_CONV", "auto"))
         fn = self._jit_cache.get(cache_key)
         if fn is not None:

@@ -572,10 +572,12 @@ def save(fname, data):
 
 
 def save_raw_bytes(arr):
-    """One NDArray as self-contained bytes (parity: NDArray::Save via
+    """One NDArray as self-contained bytes (API parity:
     MXNDArraySaveRawBytes, reference c_api.h:256 — the serialization
-    primitive under kvstore state transfer).  Same field layout as the
-    .params entries, minus the name."""
+    primitive under kvstore state transfer).  The byte layout is this
+    framework's own (same fields as our .params entries, minus the name) —
+    NOT interchangeable with blobs produced by the reference's
+    NDArray::Save stream format."""
     npv = np.asarray(arr.value)
     head = struct.pack("<QII", _MAGIC, _dtype_to_code(arr.dtype), npv.ndim)
     dims = struct.pack("<%dq" % npv.ndim, *npv.shape) if npv.ndim else b""

@@ -304,6 +304,87 @@ def _deconv_infer(attrs, in_shapes):
 
 
 # --------------------------------------------------------------------- Pooling
+# ------------------------------------------------- max-pool backward (mask)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _max_pool_core(data, window, strides, padding):
+    """Max pooling whose backward uses the equality-mask formulation.
+
+    XLA's native grad of reduce_window(max) is select-and-scatter, which
+    routes the gradient to only the FIRST maximal element of a tied
+    window.  The reference's pooling backward (mshadow unpool, reference
+    src/operator/pooling-inl.h) instead gives the gradient to EVERY
+    element equal to the window max; this VJP reproduces that semantics
+    with elementwise work only (see _max_pool_mask_bwd).  It is an
+    OPT-IN semantic-parity path, not a fast path: on the v5e it measured
+    ~0.5 ms/step slower than select-and-scatter on the ResNet stem pool
+    (b32 bench 2485 vs 2855 img/s), so MXNET_POOL_MASK_BWD defaults
+    off."""
+    return jax.lax.reduce_window(data, -jnp.inf, jax.lax.max, window,
+                                 strides, padding)
+
+
+def _max_pool_mask_fwd(data, window, strides, padding):
+    out = jax.lax.reduce_window(data, -jnp.inf, jax.lax.max, window,
+                                strides, padding)
+    return out, (data, out)
+
+
+def _max_pool_mask_bwd(window, strides, padding, res, dy):
+    """dx[i] = sum over windows w containing i of dy[w] * (x[i] == max[w]).
+
+    Formulated per *window offset* a (the a-th window covering a position,
+    a < ceil(k/s) per dim) rather than per kernel tap: the pooled arrays
+    are upsampled with repeat (a broadcast-reshape XLA fuses freely — no
+    interior padding, which breaks TPU loop fusion) and edge-shifted, and
+    window membership is a cheap periodic iota mask.  ceil(k/s)^nd terms
+    (4 for the 3x3/s2 stem pool) of pure elementwise work."""
+    import itertools
+    x, out = res
+    zero = jnp.zeros((), dy.dtype)
+    dims = range(x.ndim)
+    a_ranges = [range(-(-window[d] // strides[d])) for d in dims]
+
+    def place(arr, sentinel, offs):
+        """arr[(i+p)//s - a] on the input grid, `sentinel` out of range."""
+        r = arr
+        for d in dims:
+            s, p, a = strides[d], padding[d][0], offs[d]
+            if s > 1:
+                r = jnp.repeat(r, s, axis=d)
+            off = p - a * s
+            lo = max(0, -off)
+            hi = max(0, off + x.shape[d] - r.shape[d])
+            if lo or hi:
+                cfg = [(0, 0, 0)] * x.ndim
+                cfg[d] = (lo, hi, 0)
+                r = jax.lax.pad(r, sentinel, cfg)
+            r = jax.lax.slice_in_dim(r, off + lo, off + lo + x.shape[d],
+                                     axis=d)
+        return r
+
+    dx = None
+    for offs in itertools.product(*a_ranges):
+        mask = None
+        for d in dims:
+            s, k, p, a = strides[d], window[d], padding[d][0], offs[d]
+            if s == 1 or a * s + s - 1 < k:
+                continue   # every phase of this dim is inside the window
+            phase_ok = (jnp.arange(x.shape[d]) + p) % s + a * s < k
+            phase_ok = phase_ok.reshape(
+                [-1 if dd == d else 1 for dd in dims])
+            mask = phase_ok if mask is None else mask & phase_ok
+        dy_t = place(dy, zero, offs)
+        max_t = place(out, jnp.asarray(jnp.inf, out.dtype), offs)
+        term = jnp.where(x == max_t, dy_t, zero)
+        if mask is not None:
+            term = jnp.where(mask, term, zero)
+        dx = term if dx is None else dx + term
+    return (dx,)
+
+
+_max_pool_core.defvjp(_max_pool_mask_fwd, _max_pool_mask_bwd)
+
+
 def _pool_out_dim(i, k, s, p, convention):
     if convention == "full":
         return int(_np.ceil(float(i + 2 * p - k) / s)) + 1
@@ -365,10 +446,24 @@ def _pooling(data, kernel=None, stride=(), pad=(), pool_type="max",
         strides = (1, 1) + stride
         padding = [(0, 0), (0, 0)] + pads
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
-            jnp.iinfo(data.dtype).min
-        return jax.lax.reduce_window(data, init, jax.lax.max, window, strides,
-                                     padding)
+        if not jnp.issubdtype(data.dtype, jnp.floating):
+            return jax.lax.reduce_window(data, jnp.iinfo(data.dtype).min,
+                                         jax.lax.max, window, strides,
+                                         padding)
+        from ..base import get_env
+        if not global_pool and get_env("MXNET_POOL_MASK_BWD", "0") == "1":
+            # equality-mask backward — the reference's unpool tie
+            # semantics (every tied max gets the gradient) as an opt-in.
+            # Default OFF: on the v5e the fused elementwise formulation
+            # measured ~0.5 ms/step SLOWER than XLA's native
+            # select-and-scatter on the ResNet stem pool (b32 bench 2485
+            # vs 2855 img/s) — XLA materialises the per-offset terms
+            # instead of fusing them.  Global max pool always keeps the
+            # native grad (one window = H*W offsets here).
+            return _max_pool_core(data, window, strides,
+                                  tuple(tuple(p_) for p_ in padding))
+        return jax.lax.reduce_window(data, -jnp.inf, jax.lax.max, window,
+                                     strides, padding)
     ssum = jax.lax.reduce_window(data, 0.0, jax.lax.add,
                                  window, strides, padding)
     if pool_type == "sum":
@@ -515,6 +610,78 @@ _bn_relu_train_core.defvjp(_bn_relu_train_core_fwd, _bn_relu_train_core_bwd)
 
 
 # ------------------------------------------------- fused input-BN + stem conv
+def _s2d_eligible(x_shape, geom):
+    """Space-to-depth applies when both spatial strides are 2, the input
+    spatial dims are even, AND the packed stride-1 conv reproduces the
+    strided conv's output extent exactly: the packed form always emits
+    H/2, which equals floor((H + 2p - k)/2) + 1 only when k - 2p is 1 or
+    2 (the 7x7/p3 ImageNet stem qualifies)."""
+    k, s, p = geom
+    return (s == (2, 2)
+            and x_shape[1] % 2 == 0 and x_shape[2] % 2 == 0
+            and k[0] - 2 * p[0] in (1, 2) and k[1] - 2 * p[1] in (1, 2))
+
+
+def _s2d_pack_weights(w, geom):
+    """Logical (O, C, kh, kw) stem weights -> packed (khp, kwp, 4C, O)
+    HWIO weights for the space-to-depth conv, plus the packed padding.
+
+    A stride-2 conv on (H, W, C) is exactly a stride-1 conv on the 2x2
+    depth-packed (H/2, W/2, 4C) input: input row 2i - p + kh splits into
+    parity a = (kh - p) % 2 and packed tap u = (kh - p - a)//2 relative to
+    output row i.  Packing quadruples the MXU contraction depth — the
+    C=3 ImageNet stem runs ~4x denser (MLPerf-style stem optimisation,
+    same arithmetic)."""
+    o, c, kh, kw = w.shape
+    _, s, p = geom
+
+    def taps(kdim, pad):
+        ms = [t - pad for t in range(kdim)]
+        us = [(m - (m % 2)) // 2 for m in ms]
+        umin, umax = min(us), max(us)
+        return us, [m % 2 for m in ms], umin, umax
+
+    us_h, as_h, uhmin, uhmax = taps(kh, p[0])
+    us_w, as_w, uwmin, uwmax = taps(kw, p[1])
+    khp, kwp = uhmax - uhmin + 1, uwmax - uwmin + 1
+    wp = jnp.zeros((khp, kwp, 4 * c, o), w.dtype)
+    for ih in range(kh):
+        for iw in range(kw):
+            # packed channel layout: (a*2 + b)*C + c, matching the pack
+            # order in _s2d_pack_input
+            ch0 = (as_h[ih] * 2 + as_w[iw]) * c
+            wp = wp.at[us_h[ih] - uhmin, us_w[iw] - uwmin,
+                       ch0:ch0 + c, :].set(
+                jnp.transpose(w[:, :, ih, iw], (1, 0)))
+    pads = ((-uhmin, uhmax), (-uwmin, uwmax))
+    return wp, pads
+
+
+def _s2d_pack_input(y):
+    """(N, H, W, C) -> (N, H/2, W/2, 4C), channel layout (a*2+b)*C + c."""
+    n, h, w_, c = y.shape
+    y = jnp.reshape(y, (n, h // 2, 2, w_ // 2, 2, c))
+    y = jnp.transpose(y, (0, 1, 3, 2, 4, 5))
+    return jnp.reshape(y, (n, h // 2, w_ // 2, 4 * c))
+
+
+def _stem_conv(y, w, geom):
+    """The stem convolution, via space-to-depth when eligible and enabled
+    (MXNET_STEM_S2D=1; default off — see the A/B note in docs/perf.md)."""
+    from ..base import get_env
+    k, s, p = geom
+    if get_env("MXNET_STEM_S2D", "0") == "1" and _s2d_eligible(y.shape,
+                                                               geom):
+        wp, pads = _s2d_pack_weights(w, geom)
+        return jax.lax.conv_general_dilated(
+            _s2d_pack_input(y), wp, window_strides=(1, 1),
+            padding=list(pads), dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return jax.lax.conv_general_dilated(
+        y, jnp.transpose(w, (2, 3, 1, 0)), window_strides=s,
+        padding=[(pp, pp) for pp in p],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
 def _ibc_fwd_impl(x, b, w, eps, geom):
     """Forward of the fused input BatchNorm(fix_gamma) + Convolution.
 
@@ -531,10 +698,7 @@ def _ibc_fwd_impl(x, b, w, eps, geom):
     shift = b.astype(acc) - mean * inv
     y = x * inv.reshape(cshape).astype(x.dtype) \
         + shift.reshape(cshape).astype(x.dtype)
-    out = jax.lax.conv_general_dilated(
-        y, jnp.transpose(w, (2, 3, 1, 0)), window_strides=s,
-        padding=[(pp, pp) for pp in p],
-        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    out = _stem_conv(y, w, geom)
     return out, mean, var, inv
 
 
@@ -589,10 +753,7 @@ def _input_bn_conv_bwd(eps, geom, res, cts):
         + shift.reshape(cshape).astype(x.dtype)
 
     def conv_of_w(wt):
-        return jax.lax.conv_general_dilated(
-            y, jnp.transpose(wt, (2, 3, 1, 0)), window_strides=s,
-            padding=[(pp, pp) for pp in p],
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return _stem_conv(y, wt, geom)
     _, w_vjp = jax.vjp(conv_of_w, w)
     dw = w_vjp(g)[0]
     # d(beta) = sum over the input grid of dgrad(g, w), computed without the
@@ -746,17 +907,25 @@ def _l2_normalization(data, eps=1e-10, mode="instance"):
 
 
 @register("LRN", attr_types={"alpha": parse_float, "beta": parse_float,
-                             "knorm": parse_float, "nsize": parse_int},
-          defaults={"alpha": 1e-4, "beta": 0.75, "knorm": 2.0, "nsize": 5})
-def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
-    """Local response norm across channels (parity: lrn-inl.h)."""
+                             "knorm": parse_float, "nsize": parse_int,
+                             "layout": parse_str},
+          defaults={"alpha": 1e-4, "beta": 0.75, "knorm": 2.0, "nsize": 5},
+          layout_rule="aware")
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, layout=None):
+    """Local response norm across channels (parity: lrn-inl.h).
+
+    Layout-aware: under the executor's channel-last flow the window sum
+    runs over the minor axis directly — before this, every LRN forced a
+    physical NCHW relayout of its (large, early-network) activations in
+    both directions of the train step (the AlexNet profile's top cost)."""
+    caxis = (data.ndim - 1) if layout == "NHWC" else 1
     sq = jnp.square(data)
     half = nsize // 2
     pads = [(0, 0)] * data.ndim
-    pads[1] = (half, half)
+    pads[caxis] = (half, half)
     sq = jnp.pad(sq, pads)
     window = [1] * data.ndim
-    window[1] = nsize
+    window[caxis] = nsize
     ssum = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window),
                                  (1,) * data.ndim, [(0, 0)] * data.ndim)
     return data / jnp.power(knorm + alpha * ssum / nsize, beta)

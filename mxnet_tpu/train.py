@@ -38,6 +38,26 @@ def _pspec(*names):
     return PartitionSpec(*names)
 
 
+def _xla_options():
+    """Extra XLA compiler options for the fused step, from
+    MXNET_XLA_OPTIONS="flag=value;flag=value" (perf experiments — e.g.
+    xla_tpu_scoped_vmem_limit_kib; see docs/perf.md).  None when unset."""
+    from .base import get_env
+    spec = get_env("MXNET_XLA_OPTIONS", "")
+    if not spec:
+        return None
+    opts = {}
+    for item in spec.split(";"):
+        if not item.strip():
+            continue
+        if "=" not in item:
+            raise MXNetError(
+                "MXNET_XLA_OPTIONS: expected flag=value;..., got %r" % item)
+        k, v = item.split("=", 1)
+        opts[k.strip()] = v.strip()
+    return opts or None
+
+
 def _seq_replicated_sharding():
     """Replicated NamedSharding on the active sequence mesh, or None when
     sequence parallelism is off (the attention op shards inside)."""
@@ -229,7 +249,7 @@ class TrainStep(object):
 
     def __init__(self, symbol, optimizer, data_names=("data",),
                  label_names=("softmax_label",), mesh=None,
-                 param_shardings=None, remat=False, dtype=None):
+                 param_shardings=None, remat=False, dtype=None, zero=False):
         import jax
         from .executor import _Lowered
         self.symbol = symbol
@@ -245,6 +265,26 @@ class TrainStep(object):
         self.optimizer = optimizer
         self.num_update = 0
         self._dtype = dtype
+        # ZeRO-1 (opt-in): shard the optimizer step over dp — gradients
+        # reach the update as reduce-scattered 1/dp shards, optimizer state
+        # lives permanently sharded, and only the updated parameters are
+        # all-gathered back to replicated.  Collective bytes per step drop
+        # from 2x params (all-reduce) to 1x (scatter + gather halves), and
+        # optimizer-state HBM drops by dp.  The reference's PS design
+        # (src/kvstore/kvstore_dist.h:28-318) has no analogue — its servers
+        # hold whole key ranges; this is the TPU-native ICI shape of the
+        # same aggregation.
+        self.zero = bool(zero)
+        if self.zero:
+            if mesh is None or "dp" not in mesh.axis_names:
+                raise MXNetError(
+                    "TrainStep(zero=True) needs a mesh with a 'dp' axis")
+            if any(n in self.param_shardings for n in self.param_names):
+                raise MXNetError(
+                    "TrainStep(zero=True) shards the optimizer over dp; "
+                    "combine it with tensor-parallel param_shardings is "
+                    "not supported yet")
+        self._dp = int(mesh.shape["dp"]) if self.zero else 1
         low = self._low
 
         def fwd(params, aux, batch, rng):
@@ -269,6 +309,37 @@ class TrainStep(object):
                 policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
             fwd = jax.checkpoint(fwd, policy=policy)
 
+        def update_all(params, grads, opt_state, hyper, t, rng):
+            new_params, new_state = {}, {}
+            for n in self.param_names:
+                g = grads[n].astype(params[n].dtype)
+                new_params[n], new_state[n] = self.fopt.update(
+                    n, params[n], g, opt_state[n], hyper, t, rng=rng)
+            return new_params, new_state
+
+        def update_zero(params, grads, opt_state, hyper, t, rng):
+            """ZeRO-1 update: every optimizer rule in _FunctionalOptimizer
+            is elementwise in (w, g, state), so it applies unchanged to the
+            flat (dp, chunk) shard views; sharding constraints make XLA
+            reduce-scatter the gradient in and all-gather the updated
+            weights out."""
+            from jax.sharding import NamedSharding
+            sh_dp = NamedSharding(mesh, _pspec("dp"))
+            rep = NamedSharding(mesh, _pspec())
+            new_params, new_state = {}, {}
+            for n in self.param_names:
+                w = params[n]
+                g = grads[n].astype(w.dtype)
+                gf = jax.lax.with_sharding_constraint(
+                    self._to_shards(g), sh_dp)
+                wf = jax.lax.with_sharding_constraint(
+                    self._to_shards(w), sh_dp)
+                nwf, new_state[n] = self.fopt.update(
+                    n, wf, gf, opt_state[n], hyper, t, rng=rng)
+                nw = self._from_shards(nwf, w.shape)
+                new_params[n] = jax.lax.with_sharding_constraint(nw, rep)
+            return new_params, new_state
+
         def step(params, opt_state, aux, batch, rng, hyper, t):
             import jax.numpy as jnp
 
@@ -277,11 +348,9 @@ class TrainStep(object):
             outs, vjp_fn, aux_upd = jax.vjp(f, params, has_aux=True)
             ones = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
             grads = vjp_fn(ones)[0]
-            new_params, new_state = {}, {}
-            for n in self.param_names:
-                g = grads[n].astype(params[n].dtype)
-                new_params[n], new_state[n] = self.fopt.update(
-                    n, params[n], g, opt_state[n], hyper, t, rng=rng)
+            upd = update_zero if self.zero else update_all
+            new_params, new_state = upd(params, grads, opt_state, hyper, t,
+                                        rng)
             new_aux = dict(aux)
             new_aux.update({k: v.astype(aux[k].dtype)
                             for k, v in aux_upd.items() if k in aux})
@@ -300,14 +369,43 @@ class TrainStep(object):
             param_sh = {n: par_shard(n) for n in self.param_names}
             batch_sh = {n: NamedSharding(mesh, _pspec("dp"))
                         for n in inputs}
-            self._in_shardings = (param_sh, None, None, batch_sh, rep, None,
-                                  None)
+            state_sh = NamedSharding(mesh, _pspec("dp")) if self.zero \
+                else None
+            self._in_shardings = (param_sh, state_sh, None, batch_sh, rep,
+                                  None, None)
             self._step = jax.jit(
                 step,
                 in_shardings=self._in_shardings,
-                donate_argnums=(0, 1, 2))
+                donate_argnums=(0, 1, 2),
+                compiler_options=_xla_options())
         else:
-            self._step = jax.jit(step, donate_argnums=(0, 1, 2))
+            self._step = jax.jit(step, donate_argnums=(0, 1, 2),
+                                 compiler_options=_xla_options())
+
+    # ---------------------------------------------------------- ZeRO-1 views
+    def _chunk(self, size):
+        return -(-size // self._dp)
+
+    def _to_shards(self, x):
+        """Logical tensor -> flat (dp, chunk) view, zero-padded; device i
+        owns row i.  Elementwise optimizer math commutes with this view."""
+        import jax.numpy as jnp
+        size = 1
+        for d in x.shape:
+            size *= d
+        chunk = self._chunk(size)
+        flat = jnp.reshape(x, (-1,))
+        pad = self._dp * chunk - size
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        return jnp.reshape(flat, (self._dp, chunk))
+
+    def _from_shards(self, xf, shape):
+        import jax.numpy as jnp
+        size = 1
+        for d in shape:
+            size *= d
+        return jnp.reshape(jnp.reshape(xf, (-1,))[:size], shape)
 
     # ------------------------------------------------------------------- init
     def init(self, data_shapes, label_shapes=None, initializer=None, seed=0):
@@ -344,7 +442,22 @@ class TrainStep(object):
                 if ("moving_var" in n or "_var" in n) \
                 else _np.zeros(aux2shape[n], _np.float32)
             aux[n] = v
-        opt_state = self.fopt.init_state(params)
+        if self.zero:
+            # optimizer state is born sharded: flat (dp, chunk) host
+            # templates (padded param values, so dcasgd's prev-weight
+            # state starts AT the weight exactly as in replicated mode)
+            dp = self._dp
+
+            def flat_np(v):
+                v = _np.asarray(v)
+                chunk = self._chunk(v.size)
+                out = _np.zeros((dp, chunk), v.dtype)
+                out.reshape(-1)[:v.size] = v.reshape(-1)
+                return out
+            opt_state = self.fopt.init_state(
+                {n: flat_np(v) for n, v in params.items()})
+        else:
+            opt_state = self.fopt.init_state(params)
         if self.mesh is None:
             rep = _seq_replicated_sharding()
             if rep is not None:
@@ -380,9 +493,16 @@ class TrainStep(object):
                 return rep
             params = {n: jax.device_put(v, shard_of(n))
                       for n, v in params.items()}
-            # optimizer state tensors follow their parameter's sharding
-            opt_state = {n: tuple(jax.device_put(s, shard_of(n)) for s in st)
-                         for n, st in opt_state.items()}
+            if self.zero:
+                # ZeRO-1: optimizer state lives permanently sharded over dp
+                sh_dp = NamedSharding(self.mesh, _pspec("dp"))
+                opt_state = {n: tuple(jax.device_put(s, sh_dp) for s in st)
+                             for n, st in opt_state.items()}
+            else:
+                # optimizer state tensors follow their parameter's sharding
+                opt_state = {n: tuple(jax.device_put(s, shard_of(n))
+                                      for s in st)
+                             for n, st in opt_state.items()}
             aux = jax.device_put(aux, rep)
         return params, opt_state, aux
 
@@ -460,9 +580,11 @@ class TrainStep(object):
                                 for n in shardings[3]}
                     shardings = shardings[:3] + (batch_sh,) + shardings[4:]
                 fn = jax.jit(many, in_shardings=shardings,
-                             donate_argnums=(0, 1, 2))
+                             donate_argnums=(0, 1, 2),
+                             compiler_options=_xla_options())
             else:
-                fn = jax.jit(many, donate_argnums=(0, 1, 2))
+                fn = jax.jit(many, donate_argnums=(0, 1, 2),
+                             compiler_options=_xla_options())
             self._multi_cache[(num_steps, stacked)] = fn
         return fn(params, opt_state, aux, batch, rng, hyper,
                   _np.int32(t0))

@@ -370,6 +370,31 @@ def test_c_predict_partial_out_and_ndlist(libmx, tmp_path):
     _check(libmx, libmx.MXNDListFree(lst))
 
 
+def test_cpp_resnet_train_binary(libmx, tmp_path):
+    """A convolutional residual network with BatchNorm aux states trains
+    through the .so (parity: reference cpp-package/example/resnet.cpp):
+    generated op.h BatchNorm + operator+ junctions + projection shortcut
+    + global pooling, aux arrays threaded through MXExecutorBind."""
+    binary = os.path.join(BUILD, "resnet_train")
+    if not os.path.exists(binary):
+        pytest.skip("resnet_train binary not built")
+    rng = np.random.RandomState(0)
+    n, h = 256, 12
+    y = rng.randint(0, 2, n)
+    x = rng.randn(n, 1, h, h).astype(np.float32) * 0.4
+    x[y == 1, 0, 3:9, 3:9] += 1.5
+    data_csv = tmp_path / "d.csv"
+    label_csv = tmp_path / "l.csv"
+    np.savetxt(data_csv, x.reshape(n, -1), delimiter=",", fmt="%.5f")
+    np.savetxt(label_csv, y.astype(np.float32), delimiter=",", fmt="%g")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    res = subprocess.run([binary, str(data_csv), str(label_csv), "32", "8"],
+                         capture_output=True, text=True, env=env,
+                         timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "PASS" in res.stdout
+
+
 def test_cpp_lenet_train_binary(libmx, tmp_path):
     """The round-4 cpp-package surfaces (DataIter/CSVIter, Xavier
     initializer, Accuracy metric) train LeNet end to end through the .so

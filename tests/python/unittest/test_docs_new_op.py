@@ -1,0 +1,21 @@
+"""docs/new_op.md executable check: every ```python fence in the doc runs
+top to bottom in one namespace (the doc's own assertions are the test).
+Keeps the new-operator walkthrough from rotting (VERDICT r4 #10)."""
+import os
+import re
+
+DOC = os.path.join(os.path.dirname(__file__), "..", "..", "..",
+                   "docs", "new_op.md")
+
+
+def test_new_op_doc_snippets_run():
+    text = open(DOC).read()
+    blocks = re.findall(r"```python\n(.*?)```", text, re.S)
+    assert len(blocks) >= 4, "expected the doc's worked examples"
+    ns = {}
+    for i, block in enumerate(blocks):
+        try:
+            exec(compile(block, "new_op.md[block %d]" % i, "exec"), ns)
+        except Exception as e:
+            raise AssertionError(
+                "doc snippet %d failed: %s\n---\n%s" % (i, e, block))

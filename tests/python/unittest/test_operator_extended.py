@@ -53,9 +53,8 @@ def _bf16_ctx_list(symbol, **shapes):
 
 
 def _sweep(symbol, grad_req="write", scale=1.0, tol=None, **shapes):
-    # deterministic draws: check_consistency inits args from np.random, and
-    # an unseeded outlier near zero magnitude makes relative checks flaky
-    np.random.seed(7)
+    # check_consistency derives its own per-call RNG from the arg
+    # signature, so sweeps are order-independent without manual seeding
     check_consistency(symbol, _bf16_ctx_list(symbol, **shapes),
                       tol=tol or _BF16_TOL, grad_req=grad_req, scale=scale)
 

@@ -1,0 +1,39 @@
+"""CI-skipped hook for the axon Pallas pathology retest (VERDICT r4 #9).
+
+CI runs on the CPU backend where the pathology cannot manifest, so this
+skips there; on a real TPU run it executes the one-layer grad-in-scan
+micro from tools/pallas_axon_repro.py and records the verdict.  The day
+it reports HEALTHY, flip MXNET_NORM_CONV's default in executor.py and
+re-run tools/pallas_axon_repro.py retest to log the full-bench numbers
+(docs/perf.md "NormConv fusion")."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import jax
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    "..", "..", ".."))
+
+
+@pytest.mark.skipif(jax.default_backend() not in ("tpu", "axon"),
+                    reason="pallas dispatch pathology needs the real chip")
+def test_pallas_custom_call_dispatch_health():
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "pallas_axon_repro.py"),
+         "micro", "--iters", "10"],
+        capture_output=True, text=True, timeout=900,
+        env=dict(os.environ, PYTHONPATH=REPO))
+    assert res.stdout.strip(), res.stderr
+    rec = json.loads(res.stdout.strip().splitlines()[-1])
+    # record-only on pathological platforms: the assert documents the
+    # expectation without failing the suite while axon stays broken
+    if rec["verdict"] == "HEALTHY":
+        assert rec["ratio"] < 2.0
+    else:
+        pytest.xfail("axon custom-call dispatch still pathological: %r"
+                     % rec)

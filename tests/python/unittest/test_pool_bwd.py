@@ -1,0 +1,97 @@
+"""Equality-mask max-pool backward (ops/nn.py _max_pool_core).
+
+Pins (a) exact agreement with XLA's native select-and-scatter gradient on
+tie-free data across geometries, and (b) the reference's tie semantics —
+mshadow unpool (reference src/operator/pooling-inl.h) gives the gradient
+to EVERY element equal to the window max, where select-and-scatter picks
+only the first.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+
+
+GEOMS = [
+    # H, W, k, s, p
+    (12, 12, (3, 3), (2, 2), (1, 1)),
+    (9, 11, (2, 2), (2, 2), (0, 0)),
+    (8, 8, (3, 3), (1, 1), (1, 1)),
+    (7, 7, (3, 3), (3, 3), (1, 1)),
+]
+
+
+def _pool_grad(x, geom, env):
+    k, s, p = geom
+    for kk, v in env.items():
+        os.environ[kk] = v
+    try:
+        # weight each output position differently so routing errors show
+        def g(xx):
+            from mxnet_tpu.ops.registry import OPS
+            call = OPS.get("Pooling").make_callable(
+                {"kernel": k, "stride": s, "pad": p, "pool_type": "max"},
+                True)
+            out = call(xx)
+            w = 1.0 + jnp.arange(out.size, dtype=out.dtype).reshape(out.shape)
+            return jnp.sum(out * w)
+        return jax.grad(g)(x)
+    finally:
+        for kk in env:
+            os.environ.pop(kk, None)
+
+
+@pytest.mark.parametrize("geom", [(g[2], g[3], g[4]) for g in GEOMS])
+@pytest.mark.parametrize("hw", [(g[0], g[1]) for g in GEOMS[:1]])
+def test_mask_bwd_matches_native_no_ties(geom, hw):
+    h, w = hw
+    # a permutation makes every window tie-free
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.permutation(2 * 3 * h * w).astype(np.float32)
+                    .reshape(2, 3, h, w))
+    g1 = _pool_grad(x, geom, {"MXNET_POOL_MASK_BWD": "1"})
+    g0 = _pool_grad(x, geom, {"MXNET_POOL_MASK_BWD": "0"})
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
+                               rtol=0, atol=0)
+
+
+def test_mask_bwd_tie_semantics_match_reference():
+    """All tied maxima receive the gradient (reference unpool), not just
+    the first (select-and-scatter)."""
+    x = jnp.zeros((1, 1, 2, 2), jnp.float32)   # one 2x2 window, all tied
+    geom = ((2, 2), (2, 2), (0, 0))
+    g1 = np.asarray(_pool_grad(x, geom, {"MXNET_POOL_MASK_BWD": "1"}))
+    assert (g1 != 0).all(), g1    # every tied element got the gradient
+    g0 = np.asarray(_pool_grad(x, geom, {"MXNET_POOL_MASK_BWD": "0"}))
+    assert (g0 != 0).sum() == 1   # native XLA: first element only
+
+
+def test_mask_bwd_full_convention_and_nhwc():
+    """'full' pooling convention (asymmetric high padding) and the
+    executor's NHWC layout flow through the mask backward unchanged."""
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.permutation(3 * 2 * 7 * 7).astype(np.float32)
+                    .reshape(3, 2, 7, 7))
+
+    def run(flag):
+        os.environ["MXNET_POOL_MASK_BWD"] = flag
+        try:
+            from mxnet_tpu.ops.registry import OPS
+            def f(xx):
+                call = OPS.get("Pooling").make_callable(
+                    {"kernel": (3, 3), "stride": (2, 2), "pad": (0, 0),
+                     "pool_type": "max", "pooling_convention": "full"},
+                    True)
+                out = call(xx)
+                w = 1.0 + jnp.arange(out.size, dtype=out.dtype).reshape(out.shape)
+                return jnp.sum(out * w)
+            return jax.grad(f)(x)
+        finally:
+            os.environ.pop("MXNET_POOL_MASK_BWD", None)
+    np.testing.assert_allclose(np.asarray(run("1")), np.asarray(run("0")),
+                               rtol=0, atol=0)

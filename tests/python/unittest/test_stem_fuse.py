@@ -38,6 +38,9 @@ GEOMS = [
     (15, 5, 2, 2, 4, 8),
     (8, 1, 1, 0, 3, 8),
     (9, 3, 2, 1, 2, 6),
+    # s2-but-s2d-INELIGIBLE (k - 2p = 3: packed output would be one row
+    # larger than the strided conv's) — must route to the direct conv
+    (16, 3, 2, 0, 3, 8),
 ]
 
 
@@ -52,8 +55,10 @@ def _unfused(x, b, w, eps, k, s, p):
         padding=[(p, p), (p, p)], dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
+@pytest.mark.parametrize("s2d", ["0", "1"])
 @pytest.mark.parametrize("geom", GEOMS)
-def test_dbeta_rectangle_sums_vs_autodiff(geom, f64):
+def test_dbeta_rectangle_sums_vs_autodiff(geom, s2d, f64, monkeypatch):
+    monkeypatch.setenv("MXNET_STEM_S2D", s2d)
     h, k, s, p, cin, cout = geom
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(3, h, h, cin))
@@ -106,10 +111,14 @@ def _train_step(env, image=32, batch=4, nclass=10, seed=0):
             os.environ.pop(k, None)
 
 
-def test_graph_parity_f64_resnet50(f64):
+@pytest.mark.parametrize("s2d", ["0", "1"])
+def test_graph_parity_f64_resnet50(s2d, f64):
     """MXNET_STEM_FUSE on vs off over one full ResNet-50 train step; the
-    cifar-shaped stem (3x3/s1/p1 bn_data->conv0) rides the same peephole."""
-    p1, a1, _ = _train_step({"MXNET_STEM_FUSE": "1"})
+    cifar-shaped stem (3x3/s1/p1 bn_data->conv0) rides the same peephole.
+    s2d=1 additionally routes the fused conv through the space-to-depth
+    packing (a no-op here: the 3x3/s1 cifar stem is ineligible — the
+    eligible 7x7/s2 geometry is pinned by the unit sweep above)."""
+    p1, a1, _ = _train_step({"MXNET_STEM_FUSE": "1", "MXNET_STEM_S2D": s2d})
     p0, a0, _ = _train_step({"MXNET_STEM_FUSE": "0"})
     assert set(p1) == set(p0)
     for k in p0:

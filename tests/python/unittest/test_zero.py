@@ -1,0 +1,138 @@
+"""ZeRO-1 optimizer sharding (TrainStep(zero=True)).
+
+Pins, on the virtual 8-device CPU mesh:
+- f64 parity: one fused step in zero mode matches replicated mode exactly
+  (elementwise optimizer math commutes with the flat (dp, chunk) view);
+- the compiled step really reduce-scatters gradients (HLO check) instead
+  of all-reducing them into replicated optimizer state;
+- optimizer state is born sharded over dp (1/dp of it on each device).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel.mesh import make_mesh
+from mxnet_tpu.train import TrainStep
+
+
+@pytest.fixture
+def f64():
+    jax.config.update("jax_enable_x64", True)
+    yield
+    jax.config.update("jax_enable_x64", False)
+
+
+def _net():
+    from mxnet_tpu.models import resnet
+    return resnet.get_symbol(num_classes=8, num_layers=20,
+                             image_shape="3,16,16")
+
+
+def _one_step(opt_name, zero, mesh, batch=8, seed=0):
+    if opt_name == "sgd":
+        opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=1e-4,
+                               rescale_grad=1.0 / batch)
+    else:
+        opt = mx.optimizer.Adam(learning_rate=1e-3, rescale_grad=1.0 / batch)
+    ts = TrainStep(_net(), opt, mesh=mesh, zero=zero)
+    dshape = (batch, 3, 16, 16)
+    params, state, aux = ts.init({"data": dshape},
+                                 {"softmax_label": (batch,)})
+    params = {k: v.astype(jnp.float64) for k, v in params.items()}
+    state = {k: tuple(s.astype(jnp.float64) for s in st)
+             for k, st in state.items()}
+    aux = {k: v.astype(jnp.float64) for k, v in aux.items()}
+    rs = np.random.RandomState(seed)
+    bd = ts.shard_batch({
+        "data": rs.uniform(-1, 1, dshape).astype(np.float64),
+        "softmax_label": rs.randint(0, 8, (batch,)).astype(np.float64)})
+    key = jax.random.PRNGKey(7)
+    for _ in range(2):   # two steps so momentum state participates
+        params, state, aux, outs = ts(params, state, aux, bd, rng=key)
+    return ts, params, state, aux
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adam"])
+def test_zero_matches_replicated_f64(opt_name, f64):
+    mesh = make_mesh({"dp": 8})
+    _, p1, s1, a1 = _one_step(opt_name, True, mesh)
+    _, p0, s0, a0 = _one_step(opt_name, False, mesh)
+    for k in p0:
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p0[k]),
+                                   rtol=1e-9, atol=1e-12, err_msg=k)
+    for k in a0:
+        np.testing.assert_allclose(np.asarray(a1[k]), np.asarray(a0[k]),
+                                   rtol=1e-9, atol=1e-12, err_msg=k)
+    # sharded state round-trips to the replicated values
+    ts1, _, _, _ = (None,) * 4
+    for k, st in s1.items():
+        for s_leaf, r_leaf in zip(st, s0[k]):
+            assert s_leaf.shape[0] == 8
+            flat = np.asarray(s_leaf).reshape(-1)[:r_leaf.size]
+            np.testing.assert_allclose(flat,
+                                       np.asarray(r_leaf).reshape(-1),
+                                       rtol=1e-9, atol=1e-12, err_msg=k)
+
+
+def test_zero_collective_shape():
+    """The compiled zero step must scatter gradients to shards and gather
+    updated params.  On TPU the SPMD pipeline's ReduceScatterCreator pass
+    fuses the scatter into reduce-scatter ops; the CPU pipeline (this
+    test's backend) lacks that pass and lowers the same semantics as
+    all-reduce + dynamic-slice — accept either, but the all-gather of the
+    updated parameters (the ZeRO signature) must be present, and dynamic
+    slicing must show the per-device shard reads."""
+    mesh = make_mesh({"dp": 8})
+    batch = 8
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           rescale_grad=1.0 / batch)
+    ts = TrainStep(_net(), opt, mesh=mesh, zero=True)
+    params, state, aux = ts.init({"data": (batch, 3, 16, 16)},
+                                 {"softmax_label": (batch,)})
+    rs = np.random.RandomState(0)
+    bd = ts.shard_batch({
+        "data": rs.uniform(-1, 1, (batch, 3, 16, 16)).astype(np.float32),
+        "softmax_label": rs.randint(0, 8, (batch,)).astype(np.float32)})
+    hyper = ts.fopt.hyper(0)
+    hlo = ts._step.lower(params, state, aux, bd, jax.random.PRNGKey(0),
+                         hyper, np.int32(1)).compile().as_text()
+    scattered = hlo.count("reduce-scatter") > 0 or (
+        hlo.count("all-reduce") > 0 and hlo.count("dynamic-slice") > 0)
+    assert scattered, "zero mode compiled without gradient scattering"
+    assert hlo.count("all-gather") > 0, \
+        "zero mode compiled without the param all-gather"
+    # state shards: every leaf carries the (dp, chunk) view
+    for k, st in state.items():
+        for leaf in st:
+            assert leaf.shape[0] == 8, (k, leaf.shape)
+
+
+def test_reduce_scatter_hlo_supported_on_cpu():
+    """The explicit collective DOES lower to a reduce-scatter HLO on this
+    backend (shard_map + psum_scatter) — pinning that the graph test's
+    all-reduce+slice outcome is a missing fusion pass, not a missing
+    instruction."""
+    import re
+    mesh = make_mesh({"dp": 8})
+    from jax.sharding import PartitionSpec as P, NamedSharding
+
+    @jax.jit
+    def f(x):
+        def body(xl):
+            return jax.lax.psum_scatter(xl, "dp", scatter_dimension=0,
+                                        tiled=True)
+        return jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+                             out_specs=P("dp"))(x)
+
+    x = jax.device_put(np.ones((64, 4), np.float32),
+                       NamedSharding(mesh, P("dp")))
+    hlo = f.lower(x).compile().as_text()
+    assert len(re.findall("reduce-scatter", hlo)) > 0
+
+
+def test_zero_requires_dp_mesh():
+    with pytest.raises(mx.base.MXNetError):
+        TrainStep(_net(), mx.optimizer.SGD(), mesh=None, zero=True)

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Repro kit for the axon Pallas custom-call dispatch pathology
+(docs/perf.md "NormConv fusion": any Pallas call inside the scanned/
+donated ResNet train step executes ~6-7 ms per call site on the tunneled
+platform, while the same kernel isolated runs at device speed; the
+one-layer micro below is BISTABLE across processes — 21 ms or 4 ms per
+iteration, identical code).
+
+Two subcommands:
+
+  micro    the minimal reproducer: one fused norm-conv layer, grad,
+           inside lax.scan with donated carry — the shape of the real
+           training step.  Prints ms/iter for XLA vs Pallas lowering.
+           Healthy platform: the two are within ~2x.  Pathological axon:
+           Pallas is 5-70x slower and varies run to run.
+
+  retest   flips MXNET_NORM_CONV=1 (+ MXNET_PALLAS_CONV) on the full
+           bench.py ResNet-50 step and appends one JSON line to
+           --log (default tools/pallas_retest.jsonl) with both img/s
+           numbers — run it after any platform update; the day the
+           micro goes healthy, the NormConv fusion can ship same-day by
+           flipping its default (executor.py MXNET_NORM_CONV).
+
+Usage:
+  python tools/pallas_axon_repro.py micro [--iters 30] [--chunk 20]
+  python tools/pallas_axon_repro.py retest [--log FILE]
+
+Serialize with other chip work (docs/perf.md measurement notes).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def micro(iters=30, chunk=20):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mxnet_tpu.ops.pallas_conv import norm_conv
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(32, 56, 56, 64).astype(np.float32))
+    w = jnp.asarray(rs.randn(1, 1, 64, 64).astype(np.float32) * 0.1)
+    sc = jnp.asarray(rs.rand(64).astype(np.float32) + 0.5)
+    sh = jnp.asarray(rs.randn(64).astype(np.float32))
+
+    def run(use_pallas):
+        def loss(w_):
+            y, _, _ = norm_conv(x, w_, sc, sh, kernel=1, stride=1, pad=0,
+                                relu=True, prologue=True, stats=False,
+                                use_pallas=use_pallas)
+            return jnp.sum(y * y)
+
+        @jax.jit
+        def many(w0):
+            def body(carry, _):
+                g = jax.grad(loss)(carry)
+                return carry - 1e-6 * g, None
+            out, _ = jax.lax.scan(body, w0, None, length=chunk)
+            return out
+
+        out = many(w)          # compile + warm
+        np.asarray(out[0, 0, 0, 0])
+        t0 = time.perf_counter()
+        cur = w
+        for _ in range(iters):
+            cur = many(cur)
+        np.asarray(cur[0, 0, 0, 0])
+        return (time.perf_counter() - t0) / (iters * chunk) * 1e3
+
+    ms_xla = run(False)
+    ms_pl = run(True)
+    ratio = ms_pl / ms_xla if ms_xla else float("inf")
+    verdict = "HEALTHY" if ratio < 2.0 else "PATHOLOGICAL"
+    print(json.dumps({"micro_ms_per_iter_xla": round(ms_xla, 3),
+                      "micro_ms_per_iter_pallas": round(ms_pl, 3),
+                      "ratio": round(ratio, 2), "verdict": verdict}))
+    return 0 if ratio < 2.0 else 1
+
+
+def retest(log_path):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    def run(env):
+        for k, v in env.items():
+            os.environ[k] = v
+        try:
+            return bench.bench_resnet50_train(rounds=4)
+        finally:
+            for k in env:
+                os.environ.pop(k, None)
+
+    base = run({"MXNET_NORM_CONV": "0"})
+    fused = run({"MXNET_NORM_CONV": "1", "MXNET_PALLAS_CONV": "auto"})
+    rec = {"when": time.strftime("%Y-%m-%d %H:%M:%S"),
+           "img_per_sec_default": round(base, 1),
+           "img_per_sec_norm_conv_pallas": round(fused, 1),
+           "ship_it": fused > base}
+    with open(log_path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    m = sub.add_parser("micro")
+    m.add_argument("--iters", type=int, default=30)
+    m.add_argument("--chunk", type=int, default=20)
+    r = sub.add_parser("retest")
+    r.add_argument("--log", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "pallas_retest.jsonl"))
+    args = ap.parse_args()
+    if args.cmd == "micro":
+        return micro(args.iters, args.chunk)
+    return retest(args.log)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

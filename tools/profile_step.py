@@ -62,17 +62,14 @@ def capture(ts, params, state, aux, batch_dev, steps, out_dir):
 
 
 def load_trace_events(out_dir):
-    """xplane.pb -> trace-viewer JSON events via tensorboard_plugin_profile."""
+    """Load the trace-viewer JSON jax.profiler writes next to the xplane
+    (this image's tensorboard_plugin_profile cannot parse xplane itself)."""
     paths = sorted(glob.glob(os.path.join(
-        out_dir, "plugins/profile/*/*.xplane.pb")))
+        out_dir, "plugins/profile/*/*.trace.json.gz")))
     if not paths:
-        raise SystemExit("no xplane.pb under %s" % out_dir)
-    from tensorboard_plugin_profile.convert import raw_to_tool_data
-    data, _ = raw_to_tool_data.xspace_to_tool_data(
-        [paths[-1]], "trace_viewer", {})
-    if isinstance(data, bytes):
-        data = data.decode("utf-8", "replace")
-    return json.loads(data)
+        raise SystemExit("no .trace.json.gz under %s" % out_dir)
+    with gzip.open(paths[-1], "rt") as f:
+        return json.load(f)
 
 
 DEVICE_HINTS = ("TPU", "/device:", "Chip", "XLA Op")
@@ -96,8 +93,9 @@ def aggregate(trace, min_ms=0.0):
         if ev.get("ph") != "X" or ev.get("pid") not in device_pids:
             continue
         tname = tid_name.get((ev["pid"], ev["tid"]), "")
-        # XLA op lanes carry the HLO instruction names; skip host threads
-        if "step" in tname.lower():
+        # only the per-instruction lanes: "Steps" and "XLA Modules" carry
+        # whole-program events that would double-count every op
+        if tname not in ("XLA Ops", "Async XLA Ops"):
             continue
         per_op[ev.get("name", "?")] += ev.get("dur", 0) / 1000.0
     return {k: v for k, v in per_op.items() if v >= min_ms}, pid_name, tid_name
