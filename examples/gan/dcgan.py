@@ -14,8 +14,9 @@ This example exists to exercise the symbolic+imperative mix end to end:
   (``modD.get_input_grads()`` fed as ``out_grads`` to ``modG.backward``).
 
 Run: ``python examples/gan/dcgan.py [--epochs N] [--batch B]``
-(synthetic blob data by default so the example is self-contained; point
-``--rec`` at an ImageRecordIter .rec of real images to train on those).
+(synthetic blob data, so the example is self-contained; swap
+``blob_batches`` for an ``ImageRecordIter`` loop to train on real
+images).
 """
 from __future__ import annotations
 
@@ -85,6 +86,7 @@ def train(epochs=1, batch=32, steps_per_epoch=25, code_dim=64, lr=2e-4,
           seed=0, log=None, ctx=None):
     log = log or logging.getLogger("dcgan")
     rs = np.random.RandomState(seed + 1)
+    mx.random.seed(seed)   # deterministic init: same seed => same G/D start
     ctx = ctx or mx.context.current_context()
 
     mod_g = mx.Module(make_generator(code_dim=code_dim),

@@ -119,6 +119,12 @@ def nd_get_data_f32(handle):
         return last[1]
     buf = _np.ascontiguousarray(
         handle.asnumpy().astype("<f4", copy=False)).tobytes()
+    # view handles (slice/reshape/at) rebuild .value per access, so the
+    # identity fast path never hits for them — dedupe by content too:
+    # an unchanged value reuses the previously handed-out buffer (the
+    # memcmp is cheaper than retaining one copy per poll forever)
+    if last is not None and buf == last[1]:
+        return last[1]
     # weakref to the device array: the identity check needs it only while
     # that array is alive anyway, and a strong ref would pin every
     # superseded XLA buffer for the handle's lifetime (the bytes alone
@@ -127,7 +133,7 @@ def nd_get_data_f32(handle):
     try:
         wr = weakref.ref(cur)
     except TypeError:
-        wr = lambda: None
+        wr = (lambda: None)
     refs.append((wr, buf))
     return buf
 

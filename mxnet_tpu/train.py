@@ -322,7 +322,9 @@ class TrainStep(object):
             is elementwise in (w, g, state), so it applies unchanged to the
             flat (dp, chunk) shard views; sharding constraints make XLA
             reduce-scatter the gradient in and all-gather the updated
-            weights out."""
+            weights out.  (SGLD's shape-dependent noise draws a different
+            — equally valid — realisation than replicated mode; the
+            deterministic rules match it exactly.)"""
             from jax.sharding import NamedSharding
             sh_dp = NamedSharding(mesh, _pspec("dp"))
             rep = NamedSharding(mesh, _pspec())

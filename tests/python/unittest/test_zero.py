@@ -67,7 +67,6 @@ def test_zero_matches_replicated_f64(opt_name, f64):
         np.testing.assert_allclose(np.asarray(a1[k]), np.asarray(a0[k]),
                                    rtol=1e-9, atol=1e-12, err_msg=k)
     # sharded state round-trips to the replicated values
-    ts1, _, _, _ = (None,) * 4
     for k, st in s1.items():
         for s_leaf, r_leaf in zip(st, s0[k]):
             assert s_leaf.shape[0] == 8

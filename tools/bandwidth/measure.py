@@ -33,12 +33,49 @@ def parse_args():
     p.add_argument("--image-shape", type=str, default="3,224,224")
     p.add_argument("--num-devices", type=int, default=0,
                    help="0 = all local devices")
+    p.add_argument("--sweep", action="store_true",
+                   help="bandwidth-vs-size curve (single tensors from "
+                        "256 KB to 64 MB) instead of the model-shaped run "
+                        "— the reference measure.py's size sweep")
     return p.parse_args()
+
+
+def sweep(args):
+    """GB/s for one reduce+broadcast at each tensor size; one JSON line
+    per point (parity: the reference tool's size sweep)."""
+    import json
+    import jax
+    kv = mx.kvstore.create(args.kv_store)
+    ndev = args.num_devices or jax.local_device_count()
+    ctxs = [mx.tpu(d) for d in range(ndev)]
+    rng = np.random.RandomState(0)
+    for mb in (0.25, 1, 4, 16, 64):
+        n = int(mb * 1e6 / 4)
+        key = int(mb * 1000)
+        kv.init(key, mx.nd.zeros((n,)))
+        grads = [mx.nd.array(rng.rand(n).astype(np.float32) * (d + 1),
+                             ctx=ctxs[d]) for d in range(ndev)]
+        outs = [mx.nd.zeros((n,), ctx=ctxs[d]) for d in range(ndev)]
+        times = []
+        for _ in range(args.num_batches):
+            t0 = time.perf_counter()
+            kv.push(key, grads)
+            kv.pull(key, out=outs)
+            for o in outs:
+                o.wait_to_read()
+            times.append(time.perf_counter() - t0)
+        moved = n * 4 * ndev * 2
+        print(json.dumps({
+            "size_mb": mb, "devices": ndev, "kvstore": args.kv_store,
+            "gbps": round(moved / min(times) / 1e9, 3),
+            "ms": round(min(times) * 1e3, 2)}))
 
 
 def main():
     logging.basicConfig(level=logging.INFO)
     args = parse_args()
+    if args.sweep:
+        return sweep(args)
     net_mod = getattr(models, args.network)
     kwargs = {"num_classes": 1000, "image_shape": args.image_shape}
     if args.network == "resnet":
