@@ -395,6 +395,35 @@ def test_cpp_resnet_train_binary(libmx, tmp_path):
     assert "PASS" in res.stdout
 
 
+def test_cpp_charrnn_train_binary(libmx, tmp_path):
+    """A character LSTM trains through the .so (parity: reference
+    cpp-package/example/charRNN.cpp): generated op.h Embedding + fused-
+    parameter RNN + SwapAxis/Reshape sequence plumbing, with the hidden/
+    cell state threaded as no-grad executor inputs."""
+    binary = os.path.join(BUILD, "charrnn_train")
+    if not os.path.exists(binary):
+        pytest.skip("charrnn_train binary not built")
+    rs = np.random.RandomState(0)
+    pattern = np.array([3, 7, 1, 9, 4, 2, 8, 5])
+    n, seq = 256, 16
+    xs, ys = [], []
+    for _ in range(n):
+        phase = rs.randint(0, len(pattern))
+        ids = pattern[(phase + np.arange(seq + 1)) % len(pattern)]
+        xs.append(ids[:seq])
+        ys.append(ids[1:])
+    data_csv = tmp_path / "d.csv"
+    label_csv = tmp_path / "l.csv"
+    np.savetxt(data_csv, np.array(xs, np.float32), delimiter=",", fmt="%g")
+    np.savetxt(label_csv, np.array(ys, np.float32), delimiter=",", fmt="%g")
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    res = subprocess.run([binary, str(data_csv), str(label_csv), "16", "6"],
+                         capture_output=True, text=True, env=env,
+                         timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "PASS" in res.stdout
+
+
 def test_cpp_lenet_train_binary(libmx, tmp_path):
     """The round-4 cpp-package surfaces (DataIter/CSVIter, Xavier
     initializer, Accuracy metric) train LeNet end to end through the .so
