@@ -133,8 +133,9 @@ int main(int argc, char **argv) {
     while (it.Next()) {
       NDArray d = it.GetData();
       NDArray l = it.GetLabel();
+      std::vector<mx_float> labs = l.SyncCopyToCPU();
       args[data_idx].SyncCopyFromCPU(d.SyncCopyToCPU());
-      args[label_idx].SyncCopyFromCPU(l.SyncCopyToCPU());
+      args[label_idx].SyncCopyFromCPU(labs);
       exec.Forward(true);
       exec.Backward();
       for (int i : learnable) {
@@ -144,7 +145,6 @@ int main(int argc, char **argv) {
        * matching transposition, skipping wrap-padded tail samples */
       int pad = it.GetPadNum();
       std::vector<mx_float> probs = exec.Outputs()[0].SyncCopyToCPU();
-      std::vector<mx_float> labs = l.SyncCopyToCPU();
       for (int t = 0; t < kSeq; ++t) {
         for (int n = 0; n < batch - pad; ++n) {
           const mx_float *row = probs.data() +

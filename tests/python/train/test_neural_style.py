@@ -1,0 +1,24 @@
+"""Neural-style example smoke test (parity: reference
+example/neural-style) — the input-side imperative consumer: gradients
+flow to the data buffer only (all weights grad_req null), and the pixel
+image is optimized with an imperative Adam updater."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "..",
+                                "examples", "neural_style"))
+
+import neural_style  # noqa: E402
+
+
+def test_style_transfer_optimizes_pixels():
+    img, hist = neural_style.transfer(steps=30, seed=0)
+    assert np.isfinite(img).all()
+    # the in-graph style+content loss must fall substantially under the
+    # imperative pixel updates
+    assert hist[-1] < 0.5 * hist[0], hist[:: max(1, len(hist) // 6)]
+    # and the image must have moved away from its noisy-content init
+    content, _ = neural_style._images(0)
+    assert np.abs(img - content).mean() > 1e-3
