@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Kill stray distributed training processes on every host of a job
+(parity: reference tools/kill-mxnet.py — the cleanup companion to
+launch.py when a run wedges and leaves workers behind).
+
+Usage:
+    python tools/kill_jobs.py <prog_pattern>                  # this host
+    python tools/kill_jobs.py <prog_pattern> --hostfile HF    # every host
+    python tools/kill_jobs.py <prog_pattern> --user USER --hostfile HF
+
+Matches processes whose command line contains <prog_pattern> AND the
+MXTPU_ env contract marker (so a pattern like "train.py" cannot take down
+unrelated editors/shells holding the filename).
+"""
+from __future__ import annotations
+
+import argparse
+import getpass
+import subprocess
+import sys
+
+
+def kill_cmd(pattern, user):
+    # pgrep -f matches the full command line; the -u guard keeps the
+    # sweep inside the launching user's processes
+    return ("pgrep -u %s -f -- %s | while read p; do "
+            "grep -lq MXTPU_ /proc/$p/environ 2>/dev/null "
+            "&& kill $p && echo killed $p; done" %
+            (user, shell_quote(pattern)))
+
+
+def shell_quote(s):
+    return "'" + s.replace("'", "'\\''") + "'"
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("pattern", help="substring of the training command")
+    ap.add_argument("--hostfile", default=None,
+                    help="one host per line; default: this host only")
+    ap.add_argument("--user", default=getpass.getuser())
+    args = ap.parse_args()
+    cmd = kill_cmd(args.pattern, args.user)
+    if args.hostfile:
+        hosts = [h.strip() for h in open(args.hostfile)
+                 if h.strip() and not h.startswith("#")]
+        rc = 0
+        for h in hosts:
+            print("== %s" % h)
+            r = subprocess.run(["ssh", "-o", "BatchMode=yes",
+                                "%s@%s" % (args.user, h), cmd])
+            rc = rc or r.returncode
+        return rc
+    return subprocess.run(["bash", "-c", cmd]).returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())
