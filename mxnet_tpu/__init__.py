@@ -7,6 +7,7 @@ the architecture.
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context
 from . import base
+from . import telemetry
 from . import ndarray
 from . import ndarray as nd
 from . import random

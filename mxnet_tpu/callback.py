@@ -16,6 +16,8 @@ from __future__ import annotations
 import logging
 import time
 
+from . import telemetry as _tel
+
 __all__ = ["do_checkpoint", "module_checkpoint", "log_train_metric",
            "Speedometer", "ProgressBar"]
 
@@ -81,29 +83,55 @@ class Speedometer(object):
     """Batch-end callback that reports samples/sec every ``frequent``
     batches (parity: reference callback.py ``Speedometer``).
 
-    Keeps a single (batch-index, clock) mark; each report measures the
-    span since the mark and re-arms.  A batch index that moves backwards
+    Keeps a single (batch-index, samples, clock) mark; each report measures
+    the span since the mark and re-arms.  A batch index that moves backwards
     (a new epoch, or an iterator reset) drops the mark so the first span
     of every epoch starts clean.
+
+    When runtime telemetry is recording (``mxnet_tpu.telemetry``), the
+    sample position is read from the fit loop's ``fit_samples`` counter
+    instead of ``nbatch * batch_size`` private arithmetic — variable batch
+    sizes and multi-iterator fits then report true throughput, and the
+    meter stays consistent with the telemetry stream.  The counter is
+    process-global: if several modules fit concurrently in one process,
+    each meter reads their COMBINED throughput (loops that never advance
+    the counter fall back to batch-index arithmetic).
     """
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
         self.frequent = frequent
-        self._mark = None  # (nbatch, perf_counter) of the last report
+        # (nbatch, samples, perf_counter, source) of the last report
+        self._mark = None
+
+    def _position(self, nbatch):
+        """(cumulative sample count, source) at this callback."""
+        if _tel.enabled():
+            pos = _tel.value("fit_samples")
+            if pos is not None:
+                return pos, "telemetry"
+        return nbatch * self.batch_size, "batch"
 
     def __call__(self, param):
         now = time.perf_counter()
         n = param.nbatch
+        pos, src = self._position(n + 1)  # callback fires after the batch
         if self._mark is not None and n < self._mark[0]:
             self._mark = None
         if self._mark is None:
-            self._mark = (n, now)
+            self._mark = (n, pos, now, src)
             return
         if n % self.frequent != 0 or n == self._mark[0]:
             return
-        span = max(now - self._mark[1], 1e-12)
-        rate = (n - self._mark[0]) * self.batch_size / span
+        span = max(now - self._mark[2], 1e-12)
+        delta = pos - self._mark[1]
+        if delta <= 0 or src != self._mark[3]:
+            # the counter didn't advance across this window (a loop that
+            # doesn't feed fit_samples, e.g. score()), or telemetry toggled
+            # mid-window so the two positions have different sources —
+            # fall back to batch-index arithmetic
+            delta = (n - self._mark[0]) * self.batch_size
+        rate = delta / span
         pairs = _metric_pairs(param.eval_metric)
         if pairs:
             param.eval_metric.reset()
@@ -113,7 +141,7 @@ class Speedometer(object):
         else:
             _LOG.info("Epoch[%d] Batch[%d]  %.2f samples/s",
                       param.epoch, n, rate)
-        self._mark = (n, now)
+        self._mark = (n, pos, now, src)
 
 
 class ProgressBar(object):

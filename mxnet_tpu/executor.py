@@ -669,9 +669,15 @@ class Executor(object):
                      get_env("MXNET_STEM_S2D", "0"),
                      get_env("MXNET_POOL_MASK_BWD", "0"),
                      get_env("MXNET_PALLAS_CONV", "auto"))
+        from . import telemetry as _tel
         fn = self._jit_cache.get(cache_key)
         if fn is not None:
+            if _tel._enabled:
+                _tel.counter("jit_cache_hit", kind=kind)
             return fn
+        self._jit_last = "miss"
+        if _tel._enabled:
+            _tel.counter("jit_cache_miss", kind=kind)
         low = self._low
         collect = kind.endswith("_mon")
 
@@ -787,10 +793,21 @@ class Executor(object):
         forward+backward computation runs (one XLA program for the whole step);
         gradients are cached for the subsequent backward() call."""
         from . import profiler as _profiler
-        with _profiler.Scope("executor.forward[%s]"
-                             % ("train" if is_train else "test"),
-                             "symbolic"):
-            return self._forward_impl(is_train, **kwargs)
+        from . import telemetry as _tel
+        mode = "train" if is_train else "test"
+        with _profiler.Scope("executor.forward[%s]" % mode, "symbolic"):
+            if not _tel._enabled:
+                return self._forward_impl(is_train, **kwargs)
+            # jit="miss" on the span marks the call that paid trace+compile;
+            # steady-state calls run the cached computation (jit="hit")
+            self._jit_last = "hit"
+            # mirror=False: the profiler Scope above already records this
+            # region — don't double-count it in the chrome trace
+            with _tel.span("executor.forward", cat="executor",
+                           mirror=False, mode=mode) as sp:
+                out = self._forward_impl(is_train, **kwargs)
+                sp.tags["jit"] = self._jit_last
+            return out
 
     def _forward_impl(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
@@ -839,9 +856,10 @@ class Executor(object):
             self._monitor_cb(name, NDArray(val))
         from . import engine as _engine
         from . import profiler as _profiler
-        if _engine.is_naive() or _profiler.is_running():
-            # sync so errors surface here (NaiveEngine) and the profiler
-            # scope reflects device time, not dispatch time
+        from . import telemetry as _tel
+        if _engine.is_naive() or _profiler.is_running() or _tel._enabled:
+            # sync so errors surface here (NaiveEngine) and the profiler/
+            # telemetry spans reflect device time, not dispatch time
             import jax as _jax
             _jax.block_until_ready(outs)
         return self._output_nds
@@ -853,8 +871,13 @@ class Executor(object):
         re-executed, and stochastic ops (Dropout) reuse the masks saved in
         the forward's residuals, whether out_grads is implicit or explicit."""
         from . import profiler as _profiler
+        from . import telemetry as _tel
         with _profiler.Scope("executor.backward", "symbolic"):
-            return self._backward_impl(out_grads)
+            if not _tel._enabled:
+                return self._backward_impl(out_grads)
+            with _tel.span("executor.backward", cat="executor",
+                           mirror=False):
+                return self._backward_impl(out_grads)
 
     def _backward_impl(self, out_grads=None):
         gnames = self._grad_arg_names()
@@ -906,7 +929,8 @@ class Executor(object):
                 tgt._set_value(g)
         from . import engine as _engine
         from . import profiler as _profiler
-        if _engine.is_naive() or _profiler.is_running():
+        from . import telemetry as _tel
+        if _engine.is_naive() or _profiler.is_running() or _tel._enabled:
             import jax as _jax
             _jax.block_until_ready([g for g in grads.values()])
 

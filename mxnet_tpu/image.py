@@ -481,6 +481,7 @@ class ImageIter(mx_io.DataIter):
         pad = self.batch_size - i
         label_out = batch_label[:, 0] if self.label_width == 1 \
             else batch_label
+        mx_io._count_batch(self)
         return mx_io.DataBatch([nd.array(batch_data)],
                                [nd.array(label_out)], pad=pad,
                                provide_data=self.provide_data,
@@ -695,6 +696,7 @@ class ImageRecordIter(mx_io.DataIter):
         if data is None:
             raise StopIteration
         label_out = label[:, 0] if self.label_width == 1 else label
+        mx_io._count_batch(self)
         return mx_io.DataBatch([nd.array(data)], [nd.array(label_out)],
                                pad=pad, provide_data=self.provide_data,
                                provide_label=self.provide_label)

@@ -12,6 +12,7 @@ import gzip
 import os
 import struct
 import threading
+import time
 from collections import namedtuple
 
 import numpy as np
@@ -19,9 +20,18 @@ import numpy as np
 from .base import MXNetError
 from . import ndarray as nd
 from .ndarray import NDArray
+from . import telemetry as _tel
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "MNISTIter",
            "CSVIter", "ResizeIter", "PrefetchingIter"]
+
+
+def _count_batch(it):
+    """Telemetry hook shared by every ``DataIter.next`` implementation —
+    iterators that build batches without going through the base ``next()``
+    (image/record/bucketing pipelines) call this before returning."""
+    if _tel._enabled:
+        _tel.counter("io_batches", iter=type(it).__name__)
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -66,8 +76,12 @@ class DataIter(object):
 
     def next(self):
         if self.iter_next():
-            return DataBatch(data=self.getdata(), label=self.getlabel(),
-                             pad=self.getpad(), index=self.getindex())
+            batch = DataBatch(data=self.getdata(), label=self.getlabel(),
+                              pad=self.getpad(), index=self.getindex())
+            # counted after materialization: a getdata() that raises on a
+            # malformed row must not report a batch that never existed
+            _count_batch(self)
+            return batch
         raise StopIteration
 
     def __next__(self):
@@ -456,8 +470,11 @@ class PrefetchingIter(DataIter):
             ren = self.rename_data[i] if self.rename_data else {}
             for x in child.provide_data:
                 d = x if isinstance(x, DataDesc) else DataDesc(*x)
+                # keep the child's layout: consumers locate the batch axis
+                # through it (time-major iterators put batch on axis 1)
                 descs.append(DataDesc(ren.get(d.name, d.name), d.shape,
-                                      d.dtype))
+                                      d.dtype,
+                                      getattr(d, "layout", "NCHW")))
         return descs
 
     @property
@@ -468,7 +485,8 @@ class PrefetchingIter(DataIter):
             for x in child.provide_label:
                 d = x if isinstance(x, DataDesc) else DataDesc(*x)
                 descs.append(DataDesc(ren.get(d.name, d.name), d.shape,
-                                      d.dtype))
+                                      d.dtype,
+                                      getattr(d, "layout", "NCHW")))
         return descs
 
     def reset(self):
@@ -480,7 +498,22 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         if self._exhausted:
             return False
-        parts = [q.get() for q in self._queues]
+        telem = _tel._enabled
+        if telem:
+            # time blocked-on-producer separately: a non-trivial queue wait
+            # means the pipeline is input-bound despite the prefetch depth
+            wall = time.time()
+            t0 = time.perf_counter()
+            parts = [q.get() for q in self._queues]
+            wait = time.perf_counter() - t0
+        else:
+            parts = [q.get() for q in self._queues]
+        if telem and not any(p is self._STOP or isinstance(p, self._Raised)
+                             for p in parts):
+            # only real batches count — the end-of-epoch sentinel fetch
+            # measures producer teardown, not input wait
+            _tel.record_span("io.queue_wait", wall, wait, cat="io")
+            _tel.counter("io_prefetch_batches")
         for p in parts:
             if isinstance(p, self._Raised):
                 self._exhausted = True

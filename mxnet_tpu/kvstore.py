@@ -25,6 +25,8 @@ from .base import MXNetError, string_types
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import optimizer as opt
+from . import telemetry as _tel
+from .telemetry import nbytes_of as _nbytes
 
 __all__ = ["KVStore", "create"]
 
@@ -93,7 +95,9 @@ class KVStore(object):
         if self.type.startswith("dist"):
             # all keys of this push cross the workers in ONE fused XLA
             # all-reduce (parity: the reference batches per-key ZPush engine
-            # ops; here the batching is a single compiled collective)
+            # ops; here the batching is a single compiled collective).
+            # Timing comes from dist.allreduce's own span — a second
+            # wrapper here would double-count cat='comm' time.
             from .parallel import dist as _dist
             merged_by_key = _dist.allreduce_tree(merged_by_key)
         for k in uniq:
@@ -108,12 +112,21 @@ class KVStore(object):
                 # update_on_kvstore=False path pulls back the merged gradient,
                 # never weight + accumulated gradients.
                 self._store[k] = merged.copy()
+        # counted after the loop (mirroring pull) so a raising push —
+        # uninitialized key, failed collective — reports no phantom traffic
+        if _tel._enabled:
+            _tel.counter("kvstore_push", len(uniq))
+            _tel.counter("kvstore_push_bytes",
+                         sum(_nbytes(merged_by_key[k]) for k in uniq))
 
     def pull(self, key, out=None, priority=0):
         """Pull current values into out array(s) (parity: kvstore.pull)."""
         assert out is not None
         keys, single = _key_list(key)
         outs = _value_list(out, len(keys), single)
+        telem = _tel._enabled
+        pulls = 0
+        pulled_bytes = 0
         for k, olist in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % str(k))
@@ -123,6 +136,16 @@ class KVStore(object):
             for o in olist:
                 o._set_value(src.value if o.context == src.context
                              else src.copyto(o.context).value)
+            if telem:
+                # one pull per destination array: a multi-device fan-out
+                # moves len(olist) copies of this key, not one
+                pulls += len(olist)
+                pulled_bytes += _nbytes(src) * len(olist)
+        # counted after the loop so a raising pull (uninitialized key)
+        # doesn't report traffic that never happened
+        if telem:
+            _tel.counter("kvstore_pull", pulls)
+            _tel.counter("kvstore_pull_bytes", pulled_bytes)
 
     # -------------------------------------------------------------- optimizer
     def set_optimizer(self, optimizer):

@@ -58,18 +58,25 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
     """push grads, pull updated weights (parity: model.py:88)"""
+    from . import telemetry as _tel
+    updated = 0
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:
             continue
         kvstore.push(index, grad_list, priority=-index)
         kvstore.pull(index, arg_list, priority=-index)
+        updated += 1
+    if _tel._enabled:
+        _tel.counter("param_updates", updated, on_kvstore=True)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None):
     """aggregate via kvstore (or locally), update with local updater
     (parity: model.py:99)"""
+    from . import telemetry as _tel
+    updated = 0
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:
@@ -89,6 +96,9 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
             updater(index * num_device + k, g, w)
+        updated += 1
+    if _tel._enabled:
+        _tel.counter("param_updates", updated, on_kvstore=False)
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):

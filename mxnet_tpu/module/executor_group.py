@@ -153,13 +153,9 @@ class DataParallelExecutorGroup(object):
             aux_params[name] = block[0].copy()
 
     # ------------------------------------------------------------- computation
-    def forward(self, data_batch, is_train=None):
-        """Scatter batch slices and run each device's computation (parity:
-        executor_group.forward + _load_data/_load_label)."""
-        if is_train is None:
-            is_train = self.for_training
-        data = data_batch.data
-        label = data_batch.label if self.label_shapes else None
+    def _load_batch(self, data, label):
+        """Stage batch slices into every executor's bound input arrays
+        (parity: _load_data/_load_label)."""
         for i, ex in enumerate(self.execs):
             sl = self.slices[i]
             for name, arr in zip(self.data_names, data):
@@ -176,6 +172,23 @@ class DataParallelExecutorGroup(object):
                                 ex.arg_dict[name].context).value
                             if arr.context != ex.arg_dict[name].context
                             else arr[sl.start:sl.stop].value)
+
+    def forward(self, data_batch, is_train=None):
+        """Scatter batch slices and run each device's computation (parity:
+        executor_group.forward + _load_data/_load_label).  Staging all
+        slices before dispatching keeps the host→device input copies in one
+        telemetry span ('load_data') separate from the compute dispatch."""
+        from .. import telemetry as _tel
+        if is_train is None:
+            is_train = self.for_training
+        data = data_batch.data
+        label = data_batch.label if self.label_shapes else None
+        if _tel._enabled:
+            with _tel.span("exec_group.load_data", cat="io"):
+                self._load_batch(data, label)
+        else:
+            self._load_batch(data, label)
+        for ex in self.execs:
             ex.forward(is_train=is_train)
 
     def backward(self, out_grads=None):

@@ -419,6 +419,15 @@ class Module(BaseModule):
 
         if get_env("MXNET_FUSED_FIT", "1") == "0":
             return fallback("MXNET_FUSED_FIT=0")
+        from .. import telemetry as _tel
+        if _tel.enabled() and get_env("MXNET_TELEMETRY_FUSED", "0") != "1":
+            # the fused step is ONE XLA program — it cannot be split into
+            # forward/backward/update spans.  Telemetry implies the operator
+            # wants the step-time breakdown, so run the general path; set
+            # MXNET_TELEMETRY_FUSED=1 to keep the fused path (the breakdown
+            # then shows a single fused_step span per batch).
+            return fallback("telemetry step breakdown active "
+                            "(MXNET_TELEMETRY_FUSED=1 keeps the fused path)")
         if len(self._context) != 1:
             return fallback("multi-context binding")
         if (self._state_names or self._fixed_param_names or

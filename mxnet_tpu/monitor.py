@@ -54,6 +54,7 @@ class Monitor(object):
         self._name_ok = re.compile(pattern).match
         self._armed = False
         self._step = 0
+        self._armed_step = 0     # batch index the current arming refers to
         self._rows = []          # (step, tensor name, raw stat)
         self._installed = []     # executors hooked via install()
         # public alias: executors are handed this callable via install()
@@ -62,7 +63,7 @@ class Monitor(object):
     def _observe(self, name, array):
         """Executor callback: record one tensor if armed and name matches."""
         if self._armed and self._name_ok(name):
-            self._rows.append((self._step, name, self.stat_func(array)))
+            self._rows.append((self._armed_step, name, self.stat_func(array)))
 
     def install(self, exe):
         """Hook an executor (parity: Monitor.install / set_monitor_callback)."""
@@ -76,11 +77,15 @@ class Monitor(object):
                 array.wait_to_read()
 
     def tic(self):
-        """Begin a batch; arms collection on the interval boundary."""
+        """Begin a batch; arms collection on the interval boundary.  The
+        armed batch's index is captured BEFORE the step counter advances,
+        so rows report the batch that was actually observed (the reference
+        lineage reported the index one too high)."""
         if self._step % self.interval == 0:
             self._drain_pending()
             self._rows = []
             self._armed = True
+            self._armed_step = self._step
         self._step += 1
 
     def toc(self):
@@ -94,7 +99,7 @@ class Monitor(object):
             for name, array in zip(exe._symbol.list_arguments(),
                                    exe.arg_arrays):
                 if self._name_ok(name):
-                    self._rows.append((self._step, name,
+                    self._rows.append((self._armed_step, name,
                                        self.stat_func(array)))
         self._armed = False
         rows = self._rows

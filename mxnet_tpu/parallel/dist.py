@@ -146,9 +146,21 @@ def allreduce_arrays(arrays):
     import jax
     if jax.process_count() <= 1:
         return list(arrays)
-    stacked = [_to_global(a) for a in arrays]
-    key = tuple((tuple(a.shape), str(a.dtype)) for a in stacked)
-    outs = _sum_fn(key)(stacked)
+    def reduce():
+        stacked = [_to_global(a) for a in arrays]
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in stacked)
+        return _sum_fn(key)(stacked)
+
+    from .. import telemetry as _tel
+    if _tel._enabled:
+        with _tel.span("dist.allreduce", cat="comm", narrays=len(arrays)):
+            outs = reduce()
+            _tel.counter("dist_allreduce")
+            _tel.counter("dist_allreduce_bytes",
+                         sum(_tel.nbytes_of(a) for a in arrays))
+            jax.block_until_ready(outs)   # span reads collective time
+    else:
+        outs = reduce()
     # outputs are replicated over the worker mesh; hand back this process's
     # shard so results compose with process-local arrays (stays on device)
     return [o.addressable_shards[0].data for o in outs]

@@ -91,12 +91,32 @@ class Scope(object):
 
 
 def dump_profile():
-    """Write chrome://tracing JSON (parity: MXDumpProfile / DumpProfile)."""
+    """Write chrome://tracing JSON (parity: MXDumpProfile / DumpProfile).
+
+    Emits ``process_name``/``thread_name`` metadata events (ph='M') so the
+    trace viewer labels rows, and DRAINS the recorded events: back-to-back
+    dumps each contain only the events recorded since the previous dump.
+    Each dump overwrites ``filename`` with its delta — a caller snapshotting
+    mid-run AND at exit should ``set_config`` a fresh filename between
+    dumps, or the mid-run snapshot is replaced by the final delta.
+    """
     with _lock:
-        trace = {"traceEvents": list(_state["events"]),
-                 "displayTimeUnit": "ms"}
+        # build and write under the one lock (record_event also locks, so
+        # the event list can't move underneath), and drain only AFTER a
+        # successful write — a failing open/write keeps the events for a
+        # retry with a corrected filename
+        events = _state["events"]
+        meta = [{"name": "process_name", "ph": "M", "pid": 0,
+                 "args": {"name": "mxnet_tpu"}}]
+        for tid in sorted({e.get("tid", 0) for e in events} | {0}):
+            meta.append({"name": "thread_name", "ph": "M", "pid": 0,
+                         "tid": tid,
+                         "args": {"name": "python-main" if tid == 0
+                                  else "worker-%d" % tid}})
+        trace = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
         with open(_state["filename"], "w") as f:
             json.dump(trace, f)
+        _state["events"] = []
 
 
 # autostart parity: MXNET_PROFILER_AUTOSTART

@@ -1,0 +1,331 @@
+"""Unified runtime telemetry — counters, gauges, and timed spans.
+
+The reference lineage ships three disconnected observability affordances
+(the engine profiler's chrome trace, the per-tensor ``Monitor``, and the
+``Speedometer`` callback).  This module is the shared substrate underneath
+all of them: a process-wide, thread-safe registry of
+
+* **counters**   — monotonically accumulated values (``jit_cache_hit``,
+  ``kvstore_push_bytes``, ``fit_samples``, ...),
+* **gauges**     — last-value-wins measurements (``epoch_time``), and
+* **spans**      — timed regions with arbitrary tags (``data_wait``,
+  ``forward``, ``backward``, ``update`` per fit batch),
+
+exported as JSON-lines events.  Every span is also forwarded to
+``profiler.record_event`` so the chrome-trace output and the JSON-lines
+stream describe the SAME timeline; ``tools/telemetry_report.py`` renders a
+step-time breakdown table from a JSON-lines file.
+
+Zero-overhead-by-default contract: when telemetry is disabled (the normal
+state) every entry point degrades to a single module-global bool check —
+``span()`` returns a shared no-op singleton, ``counter``/``gauge`` return
+immediately, nothing imports jax, and no hot path gains a device sync.
+Call sites in hot loops additionally guard with ``if telemetry._enabled:``
+so they do not even build the kwargs dict.
+
+Enable programmatically with ``start(path)`` / ``stop()``, or for a whole
+process with ``MXNET_TELEMETRY=<path.jsonl>`` (autostart at import, flush
+at exit — the env-var analogue of ``MXNET_PROFILER_AUTOSTART``).
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import threading
+import time
+from collections import deque
+
+from .base import get_env
+
+__all__ = ["start", "stop", "enabled", "span", "record_span", "counter",
+           "gauge", "value", "counters", "gauges", "events", "flush",
+           "reset"]
+
+_lock = threading.RLock()
+_enabled = False
+_path = None
+_buffer = deque()     # pending event dicts (drained to _path on flush)
+_counters = {}
+_gauges = {}
+_atexit_armed = False
+_FLUSH_EVERY = 1024   # buffered events before an automatic file flush
+_BUFFER_CAP = 262144  # in-memory mode: drop oldest beyond this
+_dropped = 0
+
+
+def enabled():
+    """True while the registry is recording."""
+    return _enabled
+
+
+def start(path=None):
+    """Begin a recording session.  ``path`` (optional) is a JSON-lines
+    sink; without it events stay in memory (``events()``), capped at
+    ``_BUFFER_CAP``.  Any state left by a previous session (buffered
+    events, counter totals) is cleared — one session per file."""
+    global _enabled, _path, _atexit_armed, _dropped
+    with _lock:
+        if path:
+            open(path, "w").close()   # truncate: one run per file
+        _buffer.clear()
+        _counters.clear()
+        _gauges.clear()
+        _dropped = 0
+        _path = path
+        if path and not _atexit_armed:
+            atexit.register(stop)
+            _atexit_armed = True
+        _enabled = True
+
+
+def stop():
+    """Stop recording: emit a summary event (final counter/gauge values),
+    flush any file sink, and disable.  Idempotent."""
+    global _enabled
+    with _lock:
+        if not _enabled:
+            return
+        summary = {"type": "summary", "ts": time.time() * 1e6,
+                   "counters": dict(_counters), "gauges": dict(_gauges)}
+        if _dropped:
+            # in-memory cap evicted the run's oldest events — say so
+            summary["dropped_events"] = _dropped
+        _buffer.append(summary)
+        _enabled = False
+        _flush_locked()
+
+
+def reset():
+    """Clear all recorded state (test helper)."""
+    global _dropped
+    with _lock:
+        _buffer.clear()
+        _counters.clear()
+        _gauges.clear()
+        _dropped = 0
+
+
+def _emit_locked(ev):
+    global _dropped
+    _buffer.append(ev)
+    if _path is not None:
+        if len(_buffer) >= _FLUSH_EVERY:
+            _flush_locked()
+    elif len(_buffer) > _BUFFER_CAP:
+        _buffer.popleft()
+        _dropped += 1
+
+
+def _emit(ev):
+    with _lock:
+        if not _enabled:
+            return
+        _emit_locked(ev)
+
+
+def _flush_locked():
+    global _path
+    if _path is None or not _buffer:
+        return
+    try:
+        with open(_path, "a") as f:
+            for ev in _buffer:
+                f.write(json.dumps(ev) + "\n")
+    except OSError as e:
+        # an observability feature must not abort training: a sink that
+        # turns unwritable mid-run (dir removed, disk full) degrades to
+        # in-memory recording with a warning
+        import warnings
+        warnings.warn("telemetry sink %s became unwritable (%s); file "
+                      "export disabled, events stay in memory" % (_path, e))
+        _path = None
+        return
+    _buffer.clear()
+
+
+def flush():
+    """Drain buffered events to the file sink (no-op without a path)."""
+    with _lock:
+        _flush_locked()
+
+
+# ------------------------------------------------------------------ counters
+def counter(name, value=1, **tags):
+    """Accumulate ``value`` into counter ``name`` and emit one event.  The
+    total update and the event emission share ONE lock acquisition, so
+    concurrent threads can't write out-of-order ``total`` values."""
+    if not _enabled:
+        return
+    ev = {"type": "counter", "name": name, "ts": time.time() * 1e6,
+          "value": value}
+    if tags:
+        ev["tags"] = tags
+    with _lock:
+        if not _enabled:
+            return
+        total = _counters.get(name, 0) + value
+        _counters[name] = total
+        ev["total"] = total
+        _emit_locked(ev)
+
+
+def gauge(name, value, **tags):
+    """Record the current value of gauge ``name`` and emit one event."""
+    if not _enabled:
+        return
+    ev = {"type": "gauge", "name": name, "ts": time.time() * 1e6,
+          "value": value}
+    if tags:
+        ev["tags"] = tags
+    with _lock:
+        if not _enabled:
+            return
+        _gauges[name] = value
+        _emit_locked(ev)
+
+
+def value(name, default=None):
+    """Current accumulated value of a counter (or gauge), else ``default``."""
+    with _lock:
+        if name in _counters:
+            return _counters[name]
+        return _gauges.get(name, default)
+
+
+def counters():
+    """Snapshot of all counter totals."""
+    with _lock:
+        return dict(_counters)
+
+
+def gauges():
+    """Snapshot of all gauge values."""
+    with _lock:
+        return dict(_gauges)
+
+
+def events():
+    """Snapshot of buffered (not yet flushed) events."""
+    with _lock:
+        return list(_buffer)
+
+
+def nbytes_of(arr):
+    """Payload size of an array-like (host-side arithmetic, no device
+    sync); 0 when the size can't be derived.  Shared by the kvstore and
+    dist byte counters so the accounting stays in one place."""
+    try:
+        import numpy as _np
+        return int(arr.size) * _np.dtype(arr.dtype).itemsize
+    except Exception:
+        return 0
+
+
+# --------------------------------------------------------------------- spans
+def record_span(name, start_wall_s, dur_s, cat="runtime", mirror=True,
+                **tags):
+    """Record one already-timed span (seconds in, microseconds stored).
+
+    This is the single sink both ``span()`` and manually-timed call sites
+    feed; it also mirrors the span into the profiler's chrome-trace stream
+    so both outputs stay consistent.  Call sites whose region is ALREADY
+    wrapped in a ``profiler.Scope`` (executor forward/backward, train_step)
+    pass ``mirror=False`` so a profiler+telemetry run doesn't record the
+    same region twice in the trace.
+    """
+    if not _enabled:
+        return
+    ev = {"type": "span", "name": name, "cat": cat,
+          "ts": start_wall_s * 1e6, "dur": dur_s * 1e6}
+    if tags:
+        ev["tags"] = tags
+    _emit(ev)
+    if not mirror:
+        return
+    from . import profiler as _profiler
+    cur = threading.current_thread()
+    _profiler.record_event(name, start_wall_s * 1e6, dur_s * 1e6, cat,
+                           tid=0 if cur is threading.main_thread()
+                           else threading.get_ident())
+
+
+class _Span(object):
+    """Context manager timing a region into the telemetry stream.  Extra
+    tags may be attached mid-flight via ``self.tags[...] = ...`` (they are
+    read at ``__exit__``); ``cancel()`` suppresses emission."""
+
+    __slots__ = ("name", "cat", "tags", "mirror", "_t0", "_wall",
+                 "_cancelled")
+
+    def __init__(self, name, cat, tags, mirror=True):
+        self.name = name
+        self.cat = cat
+        self.tags = tags
+        self.mirror = mirror
+        self._cancelled = False
+
+    def __enter__(self):
+        self._wall = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._cancelled:
+            return
+        record_span(self.name, self._wall, time.perf_counter() - self._t0,
+                    self.cat, mirror=self.mirror, **self.tags)
+
+    def cancel(self):
+        self._cancelled = True
+
+
+class _NullSpan(object):
+    """Shared no-op span handed out while telemetry is disabled."""
+
+    __slots__ = ()
+    tags = {}   # class-level scratch dict: writes are cheap and ignored
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return None
+
+    def cancel(self):
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def span(name, cat="runtime", mirror=True, **tags):
+    """Timed-region context manager; a shared no-op while disabled."""
+    if not _enabled:
+        return _NULL_SPAN
+    return _Span(name, cat, tags, mirror)
+
+
+# ------------------------------------------------- autostart (env contract)
+def _autostart():
+    """MXNET_TELEMETRY=<path.jsonl> starts recording at import time.  In a
+    multi-process run (the MXTPU_* launch contract, tools/launch.py) every
+    worker would otherwise truncate and interleave the same file, so the
+    worker rank is appended — one file per process.  An unwritable path
+    degrades to disabled-with-a-warning rather than failing the import."""
+    path = get_env("MXNET_TELEMETRY")
+    if not path:
+        return False
+    rank = get_env("MXTPU_PROCESS_ID")
+    if rank is not None:
+        path = "%s.rank%s" % (path, rank)
+    try:
+        start(path)
+    except OSError as e:
+        import warnings
+        warnings.warn("MXNET_TELEMETRY=%s is unwritable (%s); telemetry "
+                      "disabled" % (path, e))
+        return False
+    return True
+
+
+_autostart()

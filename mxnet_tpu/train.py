@@ -595,16 +595,25 @@ class TrainStep(object):
     def __call__(self, params, opt_state, aux, batch, rng=None):
         """One fused step.  Returns (params, opt_state, aux, outputs)."""
         from . import profiler as _profiler
+        from . import telemetry as _tel
         if rng is None:
             rng = _random.next_key()
         hyper = self.fopt.hyper(self.num_update)
         self.num_update += 1
         with _profiler.Scope("train_step[%d]" % self.num_update, "symbolic"):
-            res = self._step(params, opt_state, aux, batch, rng, hyper,
-                             _np.int32(self.num_update))
-            if _profiler.is_running():
-                import jax
-                jax.block_until_ready(res[3])
+            if _tel._enabled:
+                with _tel.span("train_step", cat="executor", mirror=False,
+                               num_update=self.num_update):
+                    res = self._step(params, opt_state, aux, batch, rng,
+                                     hyper, _np.int32(self.num_update))
+                    import jax
+                    jax.block_until_ready(res[3])  # span reads device time
+            else:
+                res = self._step(params, opt_state, aux, batch, rng, hyper,
+                                 _np.int32(self.num_update))
+                if _profiler.is_running():
+                    import jax
+                    jax.block_until_ready(res[3])
         return res
 
 

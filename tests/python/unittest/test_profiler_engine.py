@@ -37,10 +37,11 @@ def test_profiler_records_executor_events(tmp_path):
     mx.profiler.dump_profile()
     with open(fname) as f:
         trace = json.load(f)
-    names = [e["name"] for e in trace["traceEvents"]]
+    timed = [e for e in trace["traceEvents"] if e.get("ph") != "M"]
+    names = [e["name"] for e in timed]
     assert any("executor.forward" in n for n in names), names
     assert any("executor.backward" in n for n in names), names
-    durs = [e["dur"] for e in trace["traceEvents"]]
+    durs = [e["dur"] for e in timed]
     assert all(d >= 0 for d in durs)
 
 
@@ -57,7 +58,7 @@ def test_profiler_imperative_mode(tmp_path):
     mx.profiler.dump_profile()
     with open(fname) as f:
         trace = json.load(f)
-    cats = {e["cat"] for e in trace["traceEvents"]}
+    cats = {e["cat"] for e in trace["traceEvents"] if e.get("ph") != "M"}
     assert "imperative" in cats
 
 
@@ -82,6 +83,44 @@ def test_train_step_profiled(tmp_path):
         trace = json.load(f)
     assert any(e["name"].startswith("train_step") for e in
                trace["traceEvents"])
+
+
+def test_dump_profile_metadata_and_drain(tmp_path):
+    """dump_profile labels the trace (process_name/thread_name metadata)
+    and drains recorded events — back-to-back dumps don't duplicate."""
+    fname = str(tmp_path / "drain.json")
+    mx.profiler.set_config(mode="symbolic", filename=fname)
+    mx.profiler.set_state("run")
+    try:
+        with mx.profiler.Scope("drain_probe", "operator"):
+            pass
+    finally:
+        mx.profiler.set_state("stop")
+    mx.profiler.dump_profile()
+    with open(fname) as f:
+        first = json.load(f)["traceEvents"]
+    meta_names = {e["name"] for e in first if e.get("ph") == "M"}
+    assert "process_name" in meta_names and "thread_name" in meta_names
+    assert sum(1 for e in first if e["name"] == "drain_probe") == 1
+    # second dump: the probe event must not reappear
+    mx.profiler.dump_profile()
+    with open(fname) as f:
+        second = json.load(f)["traceEvents"]
+    assert not any(e["name"] == "drain_probe" for e in second)
+
+
+def test_monitor_reports_armed_step():
+    """Monitor rows carry the index of the batch that was armed, not one
+    past it (the tic() post-increment off-by-one)."""
+    mon = mx.monitor.Monitor(interval=2, stat_func=lambda a: 0.0)
+    seen = []
+    for step in range(4):
+        mon.tic()
+        # interval=2 arms steps 0 and 2
+        mon._observe("probe", mx.nd.ones((2,)))
+        seen.extend((row[0], row[1]) for row in mon.toc())
+    steps = [s for s, name in seen if name == "probe"]
+    assert steps == [0, 2], steps
 
 
 def test_naive_engine_sync():

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Render a step-time breakdown from an mxnet_tpu telemetry JSON-lines file.
+
+Usage:
+    python tools/telemetry_report.py /tmp/telemetry.jsonl [--steps] [--epoch N]
+
+The fit loop (mxnet_tpu.module.base_module.fit) emits, per batch, one
+``step`` span (whole-batch wall time) plus component spans tagged with the
+same (epoch, nbatch): ``data_wait``, then either ``forward``/``backward``/
+``update``/``metric`` (general path) or ``fused_step``/``metric`` (fused
+path).  This tool groups those spans per step and prints:
+
+* a per-component summary (total / mean / share of step wall time),
+* coverage — how much of the measured step wall time the components
+  explain (instrumentation gaps show up as the remainder),
+* final counter totals from the run's summary event (jit cache hits,
+  kvstore traffic, io batches, ...).
+
+Pure stdlib; safe to point at a file from a live run (partial last line is
+ignored).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+# component display order; anything else observed lands after these
+# (forward_backward appears when a module subclass overrides that hook)
+_KNOWN = ["data_wait", "forward", "backward", "forward_backward", "update",
+          "fused_step", "metric"]
+
+
+def load_events(path):
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue   # partial trailing line from a live run
+    return events
+
+
+def collect_steps(events, epoch=None):
+    """{(epoch, nbatch): {"step": us, "n": count, components: {name: us}}}"""
+    steps = defaultdict(lambda: {"step": None, "n": 0, "components": {}})
+    for ev in events:
+        if ev.get("type") != "span" or ev.get("cat") != "step":
+            continue
+        tags = ev.get("tags") or {}
+        if "nbatch" not in tags:
+            continue
+        if epoch is not None and tags.get("epoch") != epoch:
+            continue
+        key = (tags.get("epoch", 0), tags["nbatch"])
+        if ev["name"] == "step":
+            # accumulate (not overwrite): a session spanning several fit()
+            # calls revisits (epoch, nbatch) keys, and coverage must compare
+            # like against like; "n" keeps the true step count for means
+            steps[key]["step"] = (steps[key]["step"] or 0.0) + ev["dur"]
+            steps[key]["n"] += 1
+        else:
+            comp = steps[key]["components"]
+            comp[ev["name"]] = comp.get(ev["name"], 0.0) + ev["dur"]
+    return dict(steps)
+
+
+def summary_counters(events):
+    for ev in reversed(events):
+        if ev.get("type") == "summary":
+            return ev.get("counters", {})
+    # no summary (run still alive): fold counter events ourselves
+    totals = {}
+    for ev in events:
+        if ev.get("type") == "counter":
+            totals[ev["name"]] = ev.get("total", 0)
+    return totals
+
+
+def component_order(steps):
+    seen = set()
+    for rec in steps.values():
+        seen.update(rec["components"])
+    return [c for c in _KNOWN if c in seen] + \
+        sorted(c for c in seen if c not in _KNOWN)
+
+
+def render(steps, counters, per_step=False, out=sys.stdout):
+    if not steps:
+        out.write("no step spans found (was the fit loop run with "
+                  "MXNET_TELEMETRY set?)\n")
+        if counters:
+            render_counters(counters, out)
+        return
+    order = component_order(steps)
+    keys = sorted(steps)
+    measured = [k for k in keys if steps[k]["step"] is not None]
+
+    if per_step:
+        hdr = ["epoch", "batch", "step_ms"] + ["%s_ms" % c for c in order]
+        out.write("  ".join("%10s" % h for h in hdr) + "\n")
+        for k in keys:
+            rec = steps[k]
+            row = ["%10d" % k[0], "%10d" % k[1],
+                   "%10.2f" % ((rec["step"] or 0.0) / 1e3)]
+            row += ["%10.2f" % (rec["components"].get(c, 0.0) / 1e3)
+                    for c in order]
+            out.write("  ".join(row) + "\n")
+        out.write("\n")
+
+    # shares/coverage compare component time against step wall time, so
+    # both sums run over the SAME steps: those whose 'step' span landed in
+    # the file (a live or killed run can have trailing partial steps)
+    total_step = sum(steps[k]["step"] for k in measured)
+    # true step count, not key count — one session can span several fit()
+    # calls that revisit the same (epoch, nbatch) keys
+    nsteps = sum(steps[k]["n"] for k in measured) or len(measured)
+    out.write("Step-time breakdown (%d steps, %.1f ms total)\n"
+              % (nsteps, total_step / 1e3))
+    if len(measured) != len(keys):
+        out.write("(%d partial step(s) without a 'step' span excluded — "
+                  "live or interrupted run)\n" % (len(keys) - len(measured)))
+    out.write("%-12s %12s %10s %8s\n"
+              % ("component", "total_ms", "mean_ms", "share"))
+    comp_sum = 0.0
+    for c in order:
+        tot = sum(steps[k]["components"].get(c, 0.0) for k in measured)
+        comp_sum += tot
+        share = tot / total_step if total_step else 0.0
+        out.write("%-12s %12.2f %10.3f %7.1f%%\n"
+                  % (c, tot / 1e3,
+                     tot / nsteps / 1e3 if nsteps else 0.0,
+                     100.0 * share))
+    if total_step:
+        out.write("%-12s %12.2f %10s %7.1f%%  (span sum vs step wall)\n"
+                  % ("coverage", comp_sum / 1e3, "",
+                     100.0 * comp_sum / total_step))
+    render_counters(counters, out)
+
+
+def render_counters(counters, out):
+    if not counters:
+        return
+    out.write("\nCounters\n")
+    for name in sorted(counters):
+        out.write("  %-24s %s\n" % (name, counters[name]))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="telemetry JSON-lines file")
+    ap.add_argument("--steps", action="store_true",
+                    help="also print the per-step table")
+    ap.add_argument("--epoch", type=int, default=None,
+                    help="restrict to one epoch")
+    args = ap.parse_args(argv)
+    events = load_events(args.path)
+    render(collect_steps(events, epoch=args.epoch),
+           summary_counters(events), per_step=args.steps)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:   # e.g. `... | head`
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)
