@@ -33,6 +33,11 @@ from . import random as _random
 
 __all__ = ["Executor"]
 
+# process-wide count of jit-compiled programs across ALL executors — a
+# per-executor gauge would overwrite itself last-writer-wins (bucketing
+# modules hold one executor per bucket)
+_jit_cache_total = 0
+
 
 def _node_uid(node, uid_map):
     u = uid_map.get(id(node))
@@ -733,8 +738,39 @@ class Executor(object):
                         jax.checkpoint_policies.dots_with_no_batch_dims_saveable
                 f = jax.checkpoint(f, policy=policy)
             fn = jax.jit(f)
+        if _tel._enabled:
+            # jax.jit is lazy: the miss's trace+compile cost lands on the
+            # FIRST invocation, not here — time that call as an
+            # `xla_compile` span so first-step compile shows up in the
+            # step breakdown instead of hiding inside `forward`
+            fn = self._timed_first_call(cache_key, fn, kind)
         self._jit_cache[cache_key] = fn
+        if _tel._enabled:
+            global _jit_cache_total
+            _jit_cache_total += 1
+            _tel.gauge("jit_cache_size", _jit_cache_total)
         return fn
+
+    def _timed_first_call(self, cache_key, fn, kind):
+        """Wrap a fresh jit so its first call records an ``xla_compile``
+        span tagged with the jit kind, then replace the cache entry with
+        the raw jit — steady-state dispatch pays nothing.  For grad kinds
+        the first call happens under jax.vjp, so the span covers trace +
+        primal compile; the pullback's own compile lands in the first
+        ``backward`` span."""
+        import time as _time
+        from . import telemetry as _tel
+
+        def first_call(*args):
+            wall = _time.time()
+            t0 = _time.perf_counter()
+            out = fn(*args)
+            _tel.record_span("xla_compile", wall,
+                             _time.perf_counter() - t0, cat="compile",
+                             kind=kind)
+            self._jit_cache[cache_key] = fn
+            return out
+        return first_call
 
     def _check_default_heads(self):
         """Warn when implicit all-ones head gradients reach non-loss outputs

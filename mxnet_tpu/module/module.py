@@ -477,6 +477,9 @@ class _FusedFit(object):
                                  data_names=tuple(module._data_names),
                                  label_names=tuple(module._label_names))
             module._fused_ts_cache = (key, self._ts)
+        # the fit loop runs its own sentinel with epoch/nbatch context —
+        # a step-level raise would hide the batch index
+        self._ts.check_numerics = False
         dev = module._context[0].jax_device()
         self._dev = dev
         arg_params, aux_params = module.get_params()

@@ -36,6 +36,14 @@ def _render(stat):
         for v in items)
 
 
+def _stat_nonfinite(stat):
+    """True if any element of a stat result is NaN/Inf (sentinel hook;
+    the dtype/finiteness policy lives in diagnostics)."""
+    from . import diagnostics as _diag
+    items = stat if isinstance(stat, list) else [stat]
+    return any(_diag._nonfinite_count(v) for v in items)
+
+
 class Monitor(object):
     """Collects per-tensor statistics every ``interval`` batches.
 
@@ -106,6 +114,26 @@ class Monitor(object):
         self._rows = []
         if self.sort:
             rows.sort(key=lambda row: row[1])
+        from . import diagnostics as _diag
+        mode = _diag.check_numerics_mode()
+        if mode is not None:
+            # the Monitor sees per-TENSOR stats, so under the sentinel it
+            # can name the first layer that went bad — finer-grained than
+            # the fit loop's whole-output check
+            bad = [name for _, name, stat in rows if _stat_nonfinite(stat)]
+            if bad:
+                from . import telemetry as _tel
+                if _tel._enabled:
+                    _tel.counter("nonfinite_monitor", len(bad))
+                if mode == "raise":
+                    # the raise discards the return value — surface the
+                    # armed batch's rows first, they are the forensics
+                    for step, name, stat in rows:
+                        _LOG.info("Batch: %7d %30s %s", step, name,
+                                  _render(stat))
+                _diag.report_nonfinite(
+                    mode, "Monitor: non-finite statistic for tensor(s) %s "
+                    "at batch %d" % (bad, self._armed_step))
         return [(step, name, _render(stat)) for step, name, stat in rows]
 
     def toc_print(self):

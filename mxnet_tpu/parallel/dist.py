@@ -151,6 +151,11 @@ def allreduce_arrays(arrays):
         key = tuple((tuple(a.shape), str(a.dtype)) for a in stacked)
         return _sum_fn(key)(stacked)
 
+    from .. import diagnostics as _diag
+    if _diag._armed:
+        # beat BEFORE entering the collective: a worker hanging inside it
+        # stops beating, so the watchdog dump's stacks show the allreduce
+        _diag.heartbeat(comm="dist.allreduce", narrays=len(arrays))
     from .. import telemetry as _tel
     if _tel._enabled:
         with _tel.span("dist.allreduce", cat="comm", narrays=len(arrays)):

@@ -38,8 +38,8 @@ from collections import deque
 from .base import get_env
 
 __all__ = ["start", "stop", "enabled", "span", "record_span", "counter",
-           "gauge", "value", "counters", "gauges", "events", "flush",
-           "reset"]
+           "gauge", "value", "counters", "gauges", "events",
+           "recent_events", "flush", "reset"]
 
 _lock = threading.RLock()
 _enabled = False
@@ -50,6 +50,8 @@ _gauges = {}
 _atexit_armed = False
 _FLUSH_EVERY = 1024   # buffered events before an automatic file flush
 _BUFFER_CAP = 262144  # in-memory mode: drop oldest beyond this
+_RECENT_CAP = 512     # event-stream tail kept past flushes (diagnostics)
+_recent = deque(maxlen=_RECENT_CAP)
 _dropped = 0
 
 
@@ -68,6 +70,7 @@ def start(path=None):
         if path:
             open(path, "w").close()   # truncate: one run per file
         _buffer.clear()
+        _recent.clear()
         _counters.clear()
         _gauges.clear()
         _dropped = 0
@@ -100,6 +103,7 @@ def reset():
     global _dropped
     with _lock:
         _buffer.clear()
+        _recent.clear()
         _counters.clear()
         _gauges.clear()
         _dropped = 0
@@ -108,6 +112,7 @@ def reset():
 def _emit_locked(ev):
     global _dropped
     _buffer.append(ev)
+    _recent.append(ev)
     if _path is not None:
         if len(_buffer) >= _FLUSH_EVERY:
             _flush_locked()
@@ -208,6 +213,18 @@ def events():
     """Snapshot of buffered (not yet flushed) events."""
     with _lock:
         return list(_buffer)
+
+
+def recent_events(n=None):
+    """Tail of the event stream (last ``_RECENT_CAP``, surviving file
+    flushes) — the "last N events" a diagnostics bundle embeds so a hang
+    or crash shows what the run was doing right before it died."""
+    with _lock:
+        evs = list(_recent)
+    if n is None:
+        return evs
+    n = int(n)
+    return evs[-n:] if n > 0 else []
 
 
 def nbytes_of(arr):

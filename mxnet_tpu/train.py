@@ -265,6 +265,9 @@ class TrainStep(object):
         self.optimizer = optimizer
         self.num_update = 0
         self._dtype = dtype
+        # MXNET_CHECK_NUMERICS hook; Module.fit's fused driver flips this
+        # off because the fit loop re-checks with epoch/nbatch context
+        self.check_numerics = True
         # ZeRO-1 (opt-in): shard the optimizer step over dp — gradients
         # reach the update as reduce-scattered 1/dp shards, optimizer state
         # lives permanently sharded, and only the updated parameters are
@@ -596,6 +599,7 @@ class TrainStep(object):
         """One fused step.  Returns (params, opt_state, aux, outputs)."""
         from . import profiler as _profiler
         from . import telemetry as _tel
+        from . import diagnostics as _diag
         if rng is None:
             rng = _random.next_key()
         hyper = self.fopt.hyper(self.num_update)
@@ -614,6 +618,14 @@ class TrainStep(object):
                 if _profiler.is_running():
                     import jax
                     jax.block_until_ready(res[3])
+        if _diag._armed:
+            _diag.heartbeat(train_step=self.num_update)
+        mode = _diag.check_numerics_mode() if self.check_numerics else None
+        if mode is not None:
+            # grads/updates live inside the donated XLA program — the
+            # outputs (loss heads) are the observable surface here
+            _diag.check_outputs(res[3], mode, where="train_step",
+                                num_update=self.num_update)
         return res
 
 

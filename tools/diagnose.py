@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Pretty-print an mxnet_tpu diagnostics bundle.
+
+Usage:
+    python tools/diagnose.py /path/to/mxtpu_diag.<reason>.pid<N>.json \
+        [--events N] [--no-stacks]
+
+Bundles are written by mxnet_tpu/diagnostics.py — by the hang watchdog
+(``MXNET_WATCHDOG_SEC``) when a training step stalls, and by the crash
+snapshot when an exception escapes ``Module.fit`` (docs/observability.md).
+This tool renders the forensic content for humans:
+
+* the incident header (reason, time, pid/rank, stall age or exception),
+* the last heartbeat (which epoch/batch/collective was in flight),
+* every Python thread's stack at dump time,
+* the telemetry counter/gauge snapshot,
+* the tail of the telemetry event stream (what the run did just before).
+
+Pure stdlib.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def load_bundle(path):
+    with open(path) as f:
+        bundle = json.load(f)
+    if bundle.get("type") != "mxtpu_diagnostics":
+        raise ValueError("not an mxnet_tpu diagnostics bundle "
+                         "(type=%r)" % bundle.get("type"))
+    return bundle
+
+
+def _fmt_ts(ts):
+    try:
+        return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
+    except (TypeError, ValueError, OverflowError):
+        return str(ts)
+
+
+def render(bundle, out=sys.stdout, events=10, stacks=True):
+    reason = bundle.get("reason", "?")
+    out.write("== mxnet_tpu diagnostics bundle: %s ==\n" % reason)
+    out.write("time   %s\n" % _fmt_ts(bundle.get("time")))
+    rank = bundle.get("rank")
+    out.write("pid    %s%s\n" % (bundle.get("pid"),
+                                 "  rank %s" % rank if rank is not None
+                                 else ""))
+    if bundle.get("argv"):
+        out.write("argv   %s\n" % " ".join(bundle["argv"]))
+    extra = bundle.get("extra") or {}
+    if "stall_sec" in extra:
+        out.write("stall  %.1fs without a heartbeat (threshold %.1fs)\n"
+                  % (extra["stall_sec"], extra.get("watchdog_sec", 0.0)))
+    exc = bundle.get("exception")
+    if exc:
+        out.write("\nException: %s: %s\n" % (exc.get("type"),
+                                             exc.get("message")))
+        for line in exc.get("traceback", []):
+            out.write("  %s\n" % line)
+
+    hb = bundle.get("heartbeat") or {}
+    out.write("\nHeartbeat\n")
+    out.write("  beats        %s\n" % hb.get("count"))
+    age = hb.get("age_sec")
+    out.write("  age          %s\n"
+              % ("%.2fs" % age if isinstance(age, (int, float)) else "never"))
+    last = hb.get("last") or {}
+    if last:
+        out.write("  last         %s\n"
+                  % "  ".join("%s=%s" % (k, v)
+                              for k, v in sorted(last.items())))
+
+    threads = bundle.get("threads") or []
+    out.write("\nThreads (%d)\n" % len(threads))
+    for t in threads:
+        out.write("  -- %s (ident %s%s)\n"
+                  % (t.get("name"), t.get("ident"),
+                     ", daemon" if t.get("daemon") else ""))
+        if stacks:
+            for line in t.get("stack", []):
+                for sub in line.splitlines():
+                    out.write("     %s\n" % sub)
+
+    tel = bundle.get("telemetry") or {}
+    counters = tel.get("counters") or {}
+    gauges = tel.get("gauges") or {}
+    out.write("\nTelemetry (%s)\n"
+              % ("recording" if tel.get("enabled") else "not recording"))
+    if counters:
+        out.write("  counters\n")
+        for name in sorted(counters):
+            out.write("    %-26s %s\n" % (name, counters[name]))
+    if gauges:
+        out.write("  gauges\n")
+        for name in sorted(gauges):
+            out.write("    %-26s %s\n" % (name, gauges[name]))
+    recent = tel.get("recent_events") or []
+    if recent and events:
+        shown = recent[-events:]
+        out.write("  last %d event(s)\n" % len(shown))
+        for ev in shown:
+            tags = ev.get("tags") or {}
+            desc = " ".join("%s=%s" % (k, v) for k, v in sorted(tags.items()))
+            if ev.get("type") == "span":
+                out.write("    span    %-20s %8.2f ms  %s\n"
+                          % (ev.get("name"), ev.get("dur", 0.0) / 1e3, desc))
+            else:
+                out.write("    %-7s %-20s %8s     %s\n"
+                          % (ev.get("type"), ev.get("name"),
+                             ev.get("total", ev.get("value")), desc))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="diagnostics bundle (JSON)")
+    ap.add_argument("--events", type=int, default=10,
+                    help="telemetry tail length to show (default 10)")
+    ap.add_argument("--no-stacks", action="store_true",
+                    help="omit per-thread stack traces")
+    args = ap.parse_args(argv)
+    try:
+        bundle = load_bundle(args.path)
+    except (OSError, ValueError) as e:
+        sys.stderr.write("diagnose: cannot read %s: %s\n" % (args.path, e))
+        return 1
+    render(bundle, events=args.events, stacks=not args.no_stacks)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:   # e.g. `... | head`
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -14,7 +14,15 @@ path).  This tool groups those spans per step and prints:
 * coverage — how much of the measured step wall time the components
   explain (instrumentation gaps show up as the remainder),
 * final counter totals from the run's summary event (jit cache hits,
-  kvstore traffic, io batches, ...).
+  kvstore traffic, io batches, ...),
+* with ``--health``: the training-health signals recorded by the
+  diagnostics layer (non-finite counters, XLA compile cost per jit kind,
+  jit-cache size, device-memory gauges — docs/observability.md).
+
+Files it cannot summarise produce a clear one-line message, never a
+traceback: an unreadable path exits 1; a file whose steps never completed
+(no ``step`` spans) or that lacks a summary event (the run never called
+``telemetry.stop()``) says so and renders what it can.
 
 Pure stdlib; safe to point at a file from a live run (partial last line is
 ignored).
@@ -70,16 +78,20 @@ def collect_steps(events, epoch=None):
     return dict(steps)
 
 
-def summary_counters(events):
+def summary_state(events):
+    """(counters, gauges, has_summary) from the run's summary event, or
+    folded from the raw stream when the run never wrote one (still alive,
+    killed, or crashed before telemetry.stop())."""
     for ev in reversed(events):
         if ev.get("type") == "summary":
-            return ev.get("counters", {})
-    # no summary (run still alive): fold counter events ourselves
-    totals = {}
+            return ev.get("counters", {}), ev.get("gauges", {}), True
+    counters, gauges = {}, {}
     for ev in events:
         if ev.get("type") == "counter":
-            totals[ev["name"]] = ev.get("total", 0)
-    return totals
+            counters[ev["name"]] = ev.get("total", 0)
+        elif ev.get("type") == "gauge":
+            gauges[ev["name"]] = ev.get("value")
+    return counters, gauges, False
 
 
 def component_order(steps):
@@ -100,6 +112,13 @@ def render(steps, counters, per_step=False, out=sys.stdout):
     order = component_order(steps)
     keys = sorted(steps)
     measured = [k for k in keys if steps[k]["step"] is not None]
+    if not measured:
+        out.write("%d step component span(s) but no completed 'step' "
+                  "spans — live or truncated run, nothing to summarise\n"
+                  % sum(len(steps[k]["components"]) for k in keys))
+        if counters:
+            render_counters(counters, out)
+        return
 
     if per_step:
         hdr = ["epoch", "batch", "step_ms"] + ["%s_ms" % c for c in order]
@@ -151,6 +170,61 @@ def render_counters(counters, out):
         out.write("  %-24s %s\n" % (name, counters[name]))
 
 
+# --------------------------------------------------------------- health view
+_NONFINITE = ["nonfinite_loss", "nonfinite_grad", "nonfinite_monitor"]
+_INCIDENTS = ["fit_crashes", "watchdog_stalls"]
+
+
+def collect_compile_spans(events):
+    """xla_compile spans (executor._get_jit first-call trace+compile)."""
+    return [ev for ev in events if ev.get("type") == "span"
+            and ev.get("cat") == "compile"]
+
+
+def render_health(counters, gauges, compile_spans, out):
+    """Training-health section: non-finite/incident counters, compile cost
+    per jit kind, cache size, device-memory gauges — rendered only for the
+    signals actually present."""
+    out.write("\nHealth\n")
+    wrote = False
+    for name in _NONFINITE + _INCIDENTS:
+        if name in counters:
+            out.write("  %-28s %s\n" % (name, counters[name]))
+            wrote = True
+    if not any(n in counters for n in _NONFINITE) and \
+            any(n in counters for n in ("fit_batches", "jit_cache_hit")):
+        # absence of counters cannot distinguish "sentinel on, zero hits"
+        # from "sentinel never enabled" — say exactly that
+        out.write("  no nonfinite_* counters (sentinel hits would appear "
+                  "here; enable MXNET_CHECK_NUMERICS to check)\n")
+        wrote = True
+    if compile_spans:
+        by_kind = defaultdict(lambda: [0, 0.0])
+        for ev in compile_spans:
+            kind = (ev.get("tags") or {}).get("kind", "?")
+            by_kind[kind][0] += 1
+            by_kind[kind][1] += ev.get("dur", 0.0)
+        total = sum(v[1] for v in by_kind.values())
+        out.write("  xla_compile: %d compile(s), %.1f ms total\n"
+                  % (sum(v[0] for v in by_kind.values()), total / 1e3))
+        for kind in sorted(by_kind):
+            n, dur = by_kind[kind]
+            out.write("    %-26s %3d  %10.1f ms\n" % (kind, n, dur / 1e3))
+        wrote = True
+    for name in ("jit_cache_size", "grad_global_norm"):
+        if name in gauges:
+            out.write("  %-28s %s\n" % (name, gauges[name]))
+            wrote = True
+    mem = sorted(n for n in gauges
+                 if n.startswith(("device_live_", "device_bytes_in_use")))
+    for name in mem:
+        out.write("  %-28s %s\n" % (name, gauges[name]))
+        wrote = True
+    if not wrote:
+        out.write("  no health signals recorded (run the fit with "
+                  "MXNET_TELEMETRY plus the diagnostics env vars)\n")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="telemetry JSON-lines file")
@@ -158,10 +232,26 @@ def main(argv=None):
                     help="also print the per-step table")
     ap.add_argument("--epoch", type=int, default=None,
                     help="restrict to one epoch")
+    ap.add_argument("--health", action="store_true",
+                    help="also print the training-health section "
+                         "(non-finite / compile / memory signals)")
     args = ap.parse_args(argv)
-    events = load_events(args.path)
-    render(collect_steps(events, epoch=args.epoch),
-           summary_counters(events), per_step=args.steps)
+    try:
+        events = load_events(args.path)
+    except (OSError, UnicodeDecodeError) as e:
+        sys.stderr.write("telemetry_report: cannot read %s: %s\n"
+                         % (args.path, getattr(e, "strerror", None) or e))
+        return 1
+    counters, gauges, has_summary = summary_state(events)
+    if events and not has_summary:
+        sys.stdout.write("note: no summary event — run still live or died "
+                         "before telemetry.stop(); totals folded from the "
+                         "raw stream\n")
+    render(collect_steps(events, epoch=args.epoch), counters,
+           per_step=args.steps)
+    if args.health:
+        render_health(counters, gauges, collect_compile_spans(events),
+                      sys.stdout)
     return 0
 
 
