@@ -271,6 +271,7 @@ def snapshot(reason, exc=None, extra=None):
             "enabled": _tel.enabled(),
             "counters": _tel.counters(),
             "gauges": _tel.gauges(),
+            "histograms": _tel.histograms(),
             "recent_events": _tel.recent_events(RECENT_EVENTS),
         },
     }

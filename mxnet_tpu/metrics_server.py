@@ -1,0 +1,253 @@
+"""Live metrics endpoint — watch a running (multi-process) job with curl.
+
+A tiny stdlib HTTP server on a daemon thread exposing the telemetry
+registry (telemetry.py) of THIS process:
+
+* ``GET /metrics``       — Prometheus text exposition (counters, gauges,
+  histograms with cumulative ``le`` buckets; span-fed latency histograms
+  are in microseconds),
+* ``GET /metrics.json``  — JSON snapshot (counters, gauges, histograms
+  with p50/p90/p99 estimates),
+* ``GET /healthz``       — liveness probe.
+
+Enable with ``MXNET_METRICS_PORT=<port>`` or ``<host>:<port>`` (autostart
+at import).  The default bind address is ``127.0.0.1`` — live training
+internals (counters, device memory, rank topology) must not be exposed to
+the whole network unless explicitly asked; use ``0.0.0.0:<port>`` for a
+fleet scrape from another host.  Under the multi-process launch contract
+(``MXTPU_PROCESS_ID``, tools/launch.py) each rank serves on ``port +
+rank``, so a 2-process ``launch_local`` fit is watchable on ports N and
+N+1 mid-run; when ``MXNET_TELEMETRY`` is not also set, an in-memory
+telemetry session starts automatically (a live endpoint implies
+recording) — no file is written.
+
+Zero-overhead-by-default contract: with ``MXNET_METRICS_PORT`` unset this
+module creates no thread and no socket, and ``start_server``/
+``stop_server`` are the only entry points that ever do.
+"""
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+import time
+import warnings
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .base import get_env
+from . import telemetry as _tel
+
+__all__ = ["start_server", "stop_server", "server_port",
+           "prometheus_text", "json_snapshot"]
+
+_lock = threading.Lock()
+_server = None
+_thread = None
+
+
+# ------------------------------------------------------------------ renderers
+def _sanitize(name):
+    """Prometheus metric-name charset ([a-zA-Z0-9_:]); gauge names like
+    ``device_live_bytes[TFRT_CPU_0]`` flatten to underscores."""
+    return re.sub(r"[^a-zA-Z0-9_:]", "_", name).strip("_")
+
+
+def _labels(extra=None):
+    """Label block: a constant ``rank`` label under the launch contract
+    (so a fleet scrape can tell workers apart) plus per-line extras."""
+    parts = []
+    rank = get_env("MXTPU_PROCESS_ID")
+    if rank is not None:
+        parts.append('rank="%s"' % rank)
+    if extra:
+        parts.extend(extra)
+    return "{%s}" % ",".join(parts) if parts else ""
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+def prometheus_text():
+    """Text exposition (version 0.0.4) of the live telemetry registry."""
+    lines = []
+    for name, v in sorted(_tel.counters().items()):
+        # the conventional _total suffix also keeps counter families from
+        # colliding with a span histogram of the sanitized same name
+        # (counter "dist_allreduce" vs span "dist.allreduce") — duplicate
+        # families with conflicting # TYPE lines fail the whole scrape
+        m = "mxtpu_" + _sanitize(name) + "_total"
+        lines.append("# TYPE %s counter" % m)
+        lines.append("%s%s %s" % (m, _labels(), _fmt(v)))
+    for name, v in sorted(_tel.gauges().items()):
+        m = "mxtpu_" + _sanitize(name)
+        lines.append("# TYPE %s gauge" % m)
+        try:
+            lines.append("%s%s %s" % (m, _labels(), _fmt(float(v))))
+        except (TypeError, ValueError):
+            continue   # non-numeric gauge has no Prometheus representation
+    for name, h in sorted(_tel.histograms().items()):
+        m = "mxtpu_" + _sanitize(name)
+        lines.append("# TYPE %s histogram" % m)
+        cum = 0
+        entries = sorted(((float("inf") if k == "inf" else float(k), n)
+                          for k, n in h["buckets"].items()),
+                         key=lambda kv: kv[0])
+        for bound, n in entries:
+            if math.isinf(bound):
+                continue   # folded into the +Inf line below
+            cum += n
+            lines.append('%s_bucket%s %d'
+                         % (m, _labels(['le="%s"' % _fmt(bound)]), cum))
+        lines.append('%s_bucket%s %d'
+                     % (m, _labels(['le="+Inf"']), h["count"]))
+        lines.append("%s_sum%s %s" % (m, _labels(), _fmt(float(h["sum"]))))
+        lines.append("%s_count%s %d" % (m, _labels(), h["count"]))
+    return "\n".join(lines) + "\n"
+
+
+def json_snapshot():
+    """One JSON document of the live registry, histogram quantiles
+    included — the machine-readable twin of ``/metrics``."""
+    hists = {}
+    for name, h in _tel.histograms().items():
+        h = dict(h)
+        h["quantiles"] = {
+            "p50": _tel.quantile_from_hist(h, 0.50),
+            "p90": _tel.quantile_from_hist(h, 0.90),
+            "p99": _tel.quantile_from_hist(h, 0.99),
+        }
+        hists[name] = h
+    return {
+        "ts": time.time(),
+        "rank": get_env("MXTPU_PROCESS_ID"),
+        "recording": _tel.enabled(),
+        "counters": _tel.counters(),
+        "gauges": _tel.gauges(),
+        "histograms": hists,
+    }
+
+
+# --------------------------------------------------------------------- server
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):   # noqa: N802 — http.server contract
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/"):
+            body = prometheus_text().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path in ("/metrics.json", "/json"):
+            body = json.dumps(json_snapshot(), default=str).encode()
+            ctype = "application/json"
+        elif path == "/healthz":
+            body = b"ok\n"
+            ctype = "text/plain; charset=utf-8"
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass   # scraper went away mid-response; nothing to clean up
+
+    def log_message(self, *args):
+        """Silence per-request stderr lines — a scraper polling every few
+        seconds must not flood the training log."""
+
+
+def _parse_endpoint(value):
+    """``MXNET_METRICS_PORT`` carries ``<port>`` or ``<host>:<port>``;
+    returns (host, port) with host defaulting to ``127.0.0.1``.  Raises
+    ValueError on a malformed value."""
+    value = str(value).strip()
+    host, sep, port = value.rpartition(":")
+    return (host if sep else "") or "127.0.0.1", int(port)
+
+
+def start_server(port=None, host=None):
+    """Start the endpoint; returns the bound port (idempotent — a running
+    server's port is returned as-is).  ``port=None`` reads
+    ``MXNET_METRICS_PORT`` (``<port>`` or ``<host>:<port>``) and applies
+    the per-rank offset; returns None when that is unset/0 (strict no-op:
+    no socket, no thread).  ``host`` defaults to the env value's host part
+    or ``127.0.0.1``.  Pass ``port=0`` explicitly for an ephemeral port
+    (tests)."""
+    global _server, _thread
+    with _lock:
+        if _server is not None:
+            return _server.server_address[1]
+        if port is None:
+            raw = get_env("MXNET_METRICS_PORT")
+            if not raw:
+                return None
+            env_host, base = _parse_endpoint(raw)
+            if base <= 0:
+                return None
+            if host is None:
+                host = env_host
+            port = base + (get_env("MXTPU_PROCESS_ID", typ=int) or 0)
+        srv = ThreadingHTTPServer((host or "127.0.0.1", port), _Handler)
+        srv.daemon_threads = True
+        _server = srv
+        _thread = threading.Thread(target=srv.serve_forever,
+                                   name="mxtpu-metrics", daemon=True)
+        _thread.start()
+        return srv.server_address[1]
+
+
+def stop_server():
+    """Shut the endpoint down and close its socket.  Idempotent."""
+    global _server, _thread
+    with _lock:
+        srv, _server = _server, None
+        t, _thread = _thread, None
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()
+    if t is not None and t.is_alive():
+        t.join(timeout=5.0)
+
+
+def server_port():
+    """Bound port while the server runs, else None."""
+    with _lock:
+        return _server.server_address[1] if _server is not None else None
+
+
+# ------------------------------------------------- autostart (env contract)
+def _autostart():
+    """MXNET_METRICS_PORT=<port> (or <host>:<port>) starts the endpoint at
+    import time (the env-var analogue of MXNET_TELEMETRY autostart).  A
+    malformed value or an unbindable port degrades to
+    disabled-with-a-warning rather than failing the import."""
+    raw = get_env("MXNET_METRICS_PORT")
+    if not raw:
+        return False
+    try:
+        _, base = _parse_endpoint(raw)
+    except ValueError:
+        warnings.warn("MXNET_METRICS_PORT=%r is not <port> or "
+                      "<host>:<port>; metrics endpoint disabled" % raw)
+        return False
+    if base <= 0:
+        return False
+    if not _tel.enabled():
+        # a live endpoint implies recording: start an in-memory session
+        # (no file) so there is something to serve
+        _tel.start()
+    try:
+        return start_server() is not None
+    except OSError as e:
+        warnings.warn("MXNET_METRICS_PORT=%s: cannot bind (%s); metrics "
+                      "endpoint disabled" % (raw, e))
+        return False
+
+
+_autostart()

@@ -58,6 +58,12 @@ def init_process_group():
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nproc, process_id=pid or 0)
     _initialized = True
+    from .. import telemetry as _tel
+    if _tel._enabled:
+        # one-shot world-identity gauges: the fleet merge and the metrics
+        # endpoint can label this process without re-deriving the contract
+        _tel.gauge("dist_world_size", nproc if (coord and nproc) else 1)
+        _tel.gauge("dist_rank", pid or 0)
 
 
 def rank():
@@ -158,7 +164,10 @@ def allreduce_arrays(arrays):
         _diag.heartbeat(comm="dist.allreduce", narrays=len(arrays))
     from .. import telemetry as _tel
     if _tel._enabled:
-        with _tel.span("dist.allreduce", cat="comm", narrays=len(arrays)):
+        # the rank tag lets a merged event stream (not just per-rank files)
+        # attribute collective latency to its worker
+        with _tel.span("dist.allreduce", cat="comm", narrays=len(arrays),
+                       rank=jax.process_index()):
             outs = reduce()
             _tel.counter("dist_allreduce")
             _tel.counter("dist_allreduce_bytes",

@@ -84,16 +84,39 @@ class Predictor(object):
 
     # ------------------------------------------------------------------- api
     def set_input(self, name, value):
-        """(parity: MXPredSetInput)"""
+        """(parity: MXPredSetInput).  While telemetry records, the host→
+        device staging copy is timed as a ``predict.set_input`` span (the
+        serving analogue of the fit loop's ``load_data``)."""
         if name not in self._input_names:
             raise MXNetError("unknown input %s (have %s)"
                              % (name, self._input_names))
-        self._executor.arg_dict[name][:] = _np.asarray(value,
-                                                       dtype=_np.float32)
+        from . import telemetry as _tel
+        if _tel._enabled:
+            with _tel.span("predict.set_input", cat="serve", input=name):
+                self._executor.arg_dict[name][:] = \
+                    _np.asarray(value, dtype=_np.float32)
+        else:
+            self._executor.arg_dict[name][:] = _np.asarray(value,
+                                                           dtype=_np.float32)
 
     def forward(self):
-        """(parity: MXPredForward)"""
-        self._outputs = self._executor.forward(is_train=False)
+        """(parity: MXPredForward).  While telemetry records, each request
+        is a ``predict.forward`` span (histogram-backed — the executor
+        blocks on its result while recording, so the span is true serving
+        latency, and ``quantile("predict.forward", 0.99)``, the metrics
+        endpoint, and the fleet report all see the tail) plus
+        ``predict_requests``/``predict_samples`` counters.  Strict no-op
+        when telemetry is disabled."""
+        from . import telemetry as _tel
+        if not _tel._enabled:
+            self._outputs = self._executor.forward(is_train=False)
+            return
+        with _tel.span("predict.forward", cat="serve"):
+            self._outputs = self._executor.forward(is_train=False)
+        _tel.counter("predict_requests")
+        if self._input_names:
+            _tel.counter("predict_samples", int(
+                self._executor.arg_dict[self._input_names[0]].shape[0]))
 
     def partial_forward(self, step):
         """Stepwise-forward protocol (parity: MXPredPartialForward,

@@ -9,7 +9,12 @@ all of them: a process-wide, thread-safe registry of
   ``kvstore_push_bytes``, ``fit_samples``, ...),
 * **gauges**     — last-value-wins measurements (``epoch_time``), and
 * **spans**      — timed regions with arbitrary tags (``data_wait``,
-  ``forward``, ``backward``, ``update`` per fit batch),
+  ``forward``, ``backward``, ``update`` per fit batch), and
+* **histograms** — fixed log-spaced bucket distributions with p50/p90/p99
+  estimation (``histogram(name, value)``); every span close also feeds a
+  latency histogram of the same name automatically, so tail latency for
+  ``step``, ``forward``, ``dist.allreduce``, ``predict.forward``, ... is
+  always available while recording,
 
 exported as JSON-lines events.  Every span is also forwarded to
 ``profiler.record_event`` so the chrome-trace output and the JSON-lines
@@ -31,6 +36,7 @@ from __future__ import annotations
 
 import atexit
 import json
+import math
 import threading
 import time
 from collections import deque
@@ -38,8 +44,9 @@ from collections import deque
 from .base import get_env
 
 __all__ = ["start", "stop", "enabled", "span", "record_span", "counter",
-           "gauge", "value", "counters", "gauges", "events",
-           "recent_events", "flush", "reset"]
+           "gauge", "histogram", "value", "counters", "gauges",
+           "histograms", "quantile", "quantile_from_hist", "hist_bound",
+           "events", "recent_events", "flush", "reset"]
 
 _lock = threading.RLock()
 _enabled = False
@@ -47,6 +54,7 @@ _path = None
 _buffer = deque()     # pending event dicts (drained to _path on flush)
 _counters = {}
 _gauges = {}
+_histograms = {}      # name -> [count, sum, min, max, {bucket_index: n}]
 _atexit_armed = False
 _FLUSH_EVERY = 1024   # buffered events before an automatic file flush
 _BUFFER_CAP = 262144  # in-memory mode: drop oldest beyond this
@@ -73,6 +81,7 @@ def start(path=None):
         _recent.clear()
         _counters.clear()
         _gauges.clear()
+        _histograms.clear()
         _dropped = 0
         _path = path
         if path and not _atexit_armed:
@@ -90,6 +99,9 @@ def stop():
             return
         summary = {"type": "summary", "ts": time.time() * 1e6,
                    "counters": dict(_counters), "gauges": dict(_gauges)}
+        if _histograms:
+            summary["histograms"] = {name: _hist_export(h)
+                                     for name, h in _histograms.items()}
         if _dropped:
             # in-memory cap evicted the run's oldest events — say so
             summary["dropped_events"] = _dropped
@@ -106,6 +118,7 @@ def reset():
         _recent.clear()
         _counters.clear()
         _gauges.clear()
+        _histograms.clear()
         _dropped = 0
 
 
@@ -189,6 +202,153 @@ def gauge(name, value, **tags):
         _emit_locked(ev)
 
 
+# ---------------------------------------------------------------- histograms
+# Fixed log-spaced buckets shared by every histogram: 20 buckets per decade
+# (~5.9% relative resolution) with finite upper bounds 10**-1 .. 10**10,
+# plus an implicit overflow bucket.  Fixed process-independent bounds are
+# what make cross-rank merging associative — tools/telemetry_agg.py sums
+# bucket counts by upper bound, no re-binning.  Values are unit-agnostic;
+# the span-fed latency histograms record MICROSECONDS (matching span
+# ``dur``).
+_HIST_PER_DECADE = 20
+_HIST_MIN_EXP = -1
+_HIST_MAX_EXP = 10
+_HIST_NFINITE = (_HIST_MAX_EXP - _HIST_MIN_EXP) * _HIST_PER_DECADE
+_HIST_RATIO = 10.0 ** (1.0 / _HIST_PER_DECADE)
+
+
+def hist_bound(index):
+    """Upper bound of bucket ``index`` (0.._HIST_NFINITE; beyond is +inf).
+    Bucket i holds values in (hist_bound(i-1), hist_bound(i)]; bucket 0
+    additionally absorbs everything at or below its bound."""
+    if index > _HIST_NFINITE:
+        return float("inf")
+    return 10.0 ** (_HIST_MIN_EXP + index / _HIST_PER_DECADE)
+
+
+def _hist_index(value):
+    if value <= 10.0 ** _HIST_MIN_EXP:
+        return 0
+    if value > 10.0 ** _HIST_MAX_EXP:
+        return _HIST_NFINITE + 1
+    idx = int(math.ceil((math.log10(value) - _HIST_MIN_EXP)
+                        * _HIST_PER_DECADE))
+    return min(max(idx, 1), _HIST_NFINITE)
+
+
+def _hist_update_locked(name, value):
+    if not math.isfinite(value):
+        # an observability layer must never crash (or poison sums/quantiles
+        # in) the run it observes; NaN/Inf *detection* is the diagnostics
+        # sentinel's job (MXNET_CHECK_NUMERICS), not the histogram's
+        return
+    h = _histograms.get(name)
+    if h is None:
+        h = _histograms[name] = [0, 0.0, value, value, {}]
+    h[0] += 1
+    h[1] += value
+    if value < h[2]:
+        h[2] = value
+    if value > h[3]:
+        h[3] = value
+    idx = _hist_index(value)
+    h[4][idx] = h[4].get(idx, 0) + 1
+
+
+def _hist_export(h):
+    """Self-describing export: sparse ``{upper_bound: count}`` buckets (the
+    overflow bucket keys as ``"inf"``) plus the bucket ratio, so consumers
+    (summary event, metrics endpoint, tools/telemetry_agg.py) need no
+    knowledge of the bucket scheme — merging sums counts by bound key and
+    quantile estimation derives each bucket's lower edge as bound/ratio."""
+    buckets = {}
+    for idx, n in sorted(h[4].items()):
+        b = hist_bound(idx)
+        buckets["inf" if math.isinf(b) else "%.6g" % b] = n
+    return {"count": h[0], "sum": h[1], "min": h[2], "max": h[3],
+            "ratio": _HIST_RATIO, "buckets": buckets}
+
+
+def histogram(name, value, **tags):
+    """Record one observation into histogram ``name``.  Observations
+    aggregate in-registry (no per-observation memory growth); one ``hist``
+    event is emitted per explicit call so the JSON-lines stream keeps the
+    raw value.  Span closes feed their histogram WITHOUT a ``hist`` event —
+    the span event already carries the raw duration.  Non-finite values
+    are dropped (NaN/Inf detection belongs to the diagnostics sentinel)."""
+    if not _enabled:
+        return
+    value = float(value)
+    if not math.isfinite(value):
+        return
+    ev = {"type": "hist", "name": name, "ts": time.time() * 1e6,
+          "value": value}
+    if tags:
+        ev["tags"] = tags
+    with _lock:
+        if not _enabled:
+            return
+        _hist_update_locked(name, value)
+        _emit_locked(ev)
+
+
+def histograms():
+    """Snapshot of all histograms in export form (see ``_hist_export``)."""
+    with _lock:
+        return {name: _hist_export(h) for name, h in _histograms.items()}
+
+
+def quantile(name, q):
+    """Estimated q-quantile (q in [0, 1]) of histogram ``name``, or None
+    when it doesn't exist.  Log-linear interpolation inside the winning
+    bucket, clamped to the observed [min, max]."""
+    with _lock:
+        h = _histograms.get(name)
+        exp = _hist_export(h) if h is not None else None
+    return quantile_from_hist(exp, q) if exp else None
+
+
+def quantile_from_hist(h, q):
+    """Quantile estimate from an exported histogram dict (pure function;
+    tools/telemetry_agg.py carries a stdlib copy for offline use — the
+    two are held together by a test)."""
+    count = h.get("count", 0)
+    if not count:
+        return None
+    q = min(max(float(q), 0.0), 1.0)
+    lo_all = h.get("min")
+    hi_all = h.get("max")
+    ratio = h.get("ratio") or _HIST_RATIO
+    entries = sorted(((float("inf") if k == "inf" else float(k), n)
+                      for k, n in h.get("buckets", {}).items()),
+                     key=lambda kv: kv[0])
+    target = q * count
+    cum = 0
+    for i, (bound, n) in enumerate(entries):
+        if cum + n < target and i < len(entries) - 1:
+            cum += n
+            continue
+        if math.isinf(bound):
+            lo = entries[i - 1][0] if i else lo_all
+            hi = hi_all
+        else:
+            # the first occupied bucket contains the observed min, so its
+            # effective lower edge is exactly that (also covers the
+            # underflow bucket, whose nominal lower edge is meaningless)
+            lo = lo_all if (i == 0 and lo_all is not None) else bound / ratio
+            hi = bound
+        if hi_all is not None:
+            hi = min(hi, hi_all)
+        if lo_all is not None:
+            lo = min(max(lo, lo_all), hi)
+        frac = (target - cum) / n if n else 1.0
+        frac = min(max(frac, 0.0), 1.0)
+        if lo <= 0 or hi <= 0:
+            return lo + (hi - lo) * frac
+        return lo * (hi / lo) ** frac
+    return hi_all
+
+
 def value(name, default=None):
     """Current accumulated value of a counter (or gauge), else ``default``."""
     with _lock:
@@ -249,6 +409,10 @@ def record_span(name, start_wall_s, dur_s, cat="runtime", mirror=True,
     wrapped in a ``profiler.Scope`` (executor forward/backward, train_step)
     pass ``mirror=False`` so a profiler+telemetry run doesn't record the
     same region twice in the trace.
+
+    Every close also feeds the latency histogram of the same name (µs), so
+    spans get p50/p90/p99 visibility for free — ``quantile("step", 0.99)``,
+    the metrics endpoint, and the cross-rank straggler report all read it.
     """
     if not _enabled:
         return
@@ -256,7 +420,11 @@ def record_span(name, start_wall_s, dur_s, cat="runtime", mirror=True,
           "ts": start_wall_s * 1e6, "dur": dur_s * 1e6}
     if tags:
         ev["tags"] = tags
-    _emit(ev)
+    with _lock:
+        if not _enabled:
+            return
+        _hist_update_locked(name, ev["dur"])
+        _emit_locked(ev)
     if not mirror:
         return
     from . import profiler as _profiler

@@ -1,0 +1,609 @@
+"""Fleet observability tests: latency histograms (bucket/quantile accuracy,
+span auto-feed, merge associativity), cross-rank aggregation + straggler
+detection (tools/telemetry_agg.py, telemetry_report --ranks), the live
+metrics endpoint (Prometheus + JSON, per-rank port offset, clean shutdown),
+observability-env propagation in tools/launch.py, predictor/bench wiring,
+and the everything-off zero-overhead guard."""
+import importlib.util
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metrics_server as ms
+from mxnet_tpu import telemetry as tel
+
+RS = np.random.RandomState
+ROOT = Path(__file__).resolve().parents[3]
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Telemetry and the endpoint are process-global: every test starts
+    and ends with both off."""
+    ms.stop_server()
+    tel.stop()
+    tel.reset()
+    yield
+    ms.stop_server()
+    tel.stop()
+    tel.reset()
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, ROOT / "tools" / ("%s.py" % name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _small_net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _http_get(port, path):
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d%s" % (port, path), timeout=5) as r:
+        return r.read().decode()
+
+
+# ---------------------------------------------------------------- histograms
+def test_histogram_quantile_accuracy():
+    tel.start()
+    for v in range(1, 1001):
+        tel.histogram("lat", float(v))
+    h = tel.histograms()["lat"]
+    assert h["count"] == 1000
+    assert h["sum"] == pytest.approx(500500.0)
+    assert h["min"] == 1.0 and h["max"] == 1000.0
+    # 20 log buckets/decade ⇒ ~6% bucket resolution; interpolation lands
+    # well inside 10% of the exact percentiles
+    assert tel.quantile("lat", 0.50) == pytest.approx(500, rel=0.10)
+    assert tel.quantile("lat", 0.90) == pytest.approx(900, rel=0.10)
+    assert tel.quantile("lat", 0.99) == pytest.approx(990, rel=0.10)
+    # tails clamp to the observed extremes
+    assert tel.quantile("lat", 0.0) == 1.0
+    assert tel.quantile("lat", 1.0) == 1000.0
+
+
+def test_histogram_edge_cases():
+    tel.start()
+    assert tel.quantile("nope", 0.5) is None
+    tel.histogram("one", 42.0)
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert tel.quantile("one", q) == pytest.approx(42.0)
+    # non-positive and huge values land in the underflow/overflow buckets
+    # without breaking anything
+    tel.histogram("wild", 0.0)
+    tel.histogram("wild", -3.0)
+    tel.histogram("wild", 1e12)
+    h = tel.histograms()["wild"]
+    assert h["count"] == 3 and "inf" in h["buckets"]
+    assert tel.quantile("wild", 1.0) == pytest.approx(1e12)
+
+
+def test_span_close_feeds_histogram():
+    tel.start()
+    with tel.span("region", cat="unit"):
+        pass
+    tel.record_span("region", time.time(), 0.002, mirror=False)
+    h = tel.histograms()["region"]
+    assert h["count"] == 2
+    assert h["max"] == pytest.approx(2000.0, rel=0.01)   # µs
+    # no 'hist' events for span-fed updates — the span event carries the
+    # raw duration already
+    assert not any(e["type"] == "hist" for e in tel.events())
+
+
+def test_summary_event_embeds_histograms(tmp_path):
+    fname = str(tmp_path / "t.jsonl")
+    tel.start(fname)
+    tel.histogram("h", 123.0, kind="explicit")
+    tel.stop()
+    events = [json.loads(line) for line in open(fname) if line.strip()]
+    (hist_ev,) = [e for e in events if e["type"] == "hist"]
+    assert hist_ev["value"] == 123.0 and hist_ev["tags"] == {
+        "kind": "explicit"}
+    (summary,) = [e for e in events if e["type"] == "summary"]
+    h = summary["histograms"]["h"]
+    assert h["count"] == 1 and h["sum"] == 123.0
+    assert sum(h["buckets"].values()) == 1
+
+
+def test_agg_quantile_matches_telemetry():
+    """tools/telemetry_agg.py carries a stdlib copy of quantile_from_hist;
+    this holds the two implementations in lockstep."""
+    agg = _load_tool("telemetry_agg")
+    tel.start()
+    rng = RS(7)
+    for v in 10.0 ** (rng.uniform(-2, 7, 500)):
+        tel.histogram("x", float(v))
+    h = tel.histograms()["x"]
+    for q in (0.0, 0.1, 0.5, 0.9, 0.99, 1.0):
+        assert agg.quantile_from_hist(h, q) == tel.quantile_from_hist(h, q)
+
+
+def test_histogram_merge_associativity():
+    agg = _load_tool("telemetry_agg")
+    rng = RS(3)
+    exports, all_vals = [], []
+    for _ in range(3):
+        vals = [float(v) for v in rng.randint(1, 100000, 200)]
+        all_vals += vals
+        tel.start()
+        for v in vals:
+            tel.histogram("m", v)
+        exports.append(tel.histograms()["m"])
+        tel.stop()
+    ab_c = agg.merge_histograms(
+        agg.merge_histograms(exports[0], exports[1]), exports[2])
+    a_bc = agg.merge_histograms(
+        exports[0], agg.merge_histograms(exports[1], exports[2]))
+    assert ab_c == a_bc   # integer-valued observations ⇒ exact equality
+    assert ab_c["count"] == 600
+    assert ab_c["min"] == min(all_vals) and ab_c["max"] == max(all_vals)
+    assert sum(ab_c["buckets"].values()) == 600
+    got = agg.quantile_from_hist(ab_c, 0.5)
+    assert got == pytest.approx(float(np.percentile(all_vals, 50)), rel=0.1)
+
+
+# ------------------------------------------------- cross-rank agg + straggler
+def _write_rank_files(base, rank_step_ms, nsteps=40):
+    """Synthetic per-rank telemetry files with controlled span latencies."""
+    for rank, step_ms in rank_step_ms.items():
+        tel.start("%s.rank%d" % (base, rank))
+        t = time.time()
+        for i in range(nsteps):
+            tel.record_span("step", t, step_ms / 1e3, cat="step",
+                            epoch=0, nbatch=i, mirror=False)
+            tel.record_span("dist.allreduce", t, step_ms / 4e3, cat="comm",
+                            rank=rank, mirror=False)
+        tel.counter("fit_samples", nsteps * 10)
+        tel.gauge("epoch_time", step_ms * nsteps / 1e3)
+        tel.stop()
+
+
+def test_straggler_detection_flags_slow_rank(tmp_path):
+    agg = _load_tool("telemetry_agg")
+    base = str(tmp_path / "t.jsonl")
+    _write_rank_files(base, {0: 10.0, 1: 10.0, 2: 31.0})
+    files = agg.rank_files(base)
+    assert [agg.rank_of(p) for p in files] == [0, 1, 2]
+    merged = agg.aggregate(files)
+    # counters summed, gauges per-rank
+    assert merged["counters"]["fit_samples"] == 3 * 400
+    assert set(merged["gauges_by_rank"]) == {0, 1, 2}
+    # bucket-merged histogram covers all ranks
+    assert merged["histograms"]["step"]["count"] == 120
+    rep = merged["skew"]["step"]
+    assert rep["slowest_rank"] == 2
+    assert rep["straggler"] == 2
+    assert rep["skew_ratio"] == pytest.approx(3.1, rel=0.05)
+    assert rep["ranks"][2]["p99"] == pytest.approx(31000.0, rel=0.01)
+    assert merged["skew"]["dist.allreduce"]["straggler"] == 2
+
+
+def test_no_straggler_when_ranks_agree(tmp_path):
+    agg = _load_tool("telemetry_agg")
+    base = str(tmp_path / "t.jsonl")
+    _write_rank_files(base, {0: 10.0, 1: 10.5})
+    merged = agg.aggregate(agg.rank_files(base))
+    rep = merged["skew"]["step"]
+    assert rep["straggler"] is None
+    assert rep["slowest_rank"] == 1
+
+
+def test_agg_cli_and_report_ranks(tmp_path, capsys):
+    agg = _load_tool("telemetry_agg")
+    report = _load_tool("telemetry_report")
+    base = str(tmp_path / "t.jsonl")
+    _write_rank_files(base, {0: 10.0, 1: 30.0})
+    assert agg.main([base]) == 0
+    out = capsys.readouterr().out
+    assert "2 rank file(s)" in out
+    assert "STRAGGLER" in out and "slowest rank: 1" in out
+    assert "fit_samples" in out and "800" in out
+    # the report tool's --ranks view rides the same library
+    assert report.main([base, "--ranks"]) == 0
+    out = capsys.readouterr().out
+    assert "Per-rank skew" in out and "STRAGGLER" in out
+    # machine-readable view
+    assert agg.main([base, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["skew"]["step"]["straggler"] == 1
+    # missing files get a one-line message, not a traceback
+    assert agg.main([str(tmp_path / "absent.jsonl")]) == 1
+    assert "no files match" in capsys.readouterr().err
+    # --ranks renders the fleet view only: single-rank flags are rejected
+    # loudly instead of silently dropped
+    for bad in (["--health"], ["--steps"], ["--epoch", "0"]):
+        with pytest.raises(SystemExit):
+            report.main([base, "--ranks"] + bad)
+        assert "--ranks" in capsys.readouterr().err
+
+
+def test_agg_live_file_without_summary(tmp_path):
+    """A killed/live rank (no summary event) still folds from the stream —
+    including its HISTOGRAMS, rebuilt from span durations and hist events,
+    so the merged fleet tail latency covers the dead rank too."""
+    agg = _load_tool("telemetry_agg")
+    base = str(tmp_path / "t.jsonl")
+    # rank 0: completed run (summary present)
+    tel.start(base + ".rank0")
+    tel.record_span("step", time.time(), 0.01, cat="step", mirror=False)
+    tel.stop()
+    # rank 1: killed mid-run — no summary event
+    tel.start(base + ".rank1")
+    tel.record_span("step", time.time(), 0.03, cat="step", mirror=False)
+    tel.histogram("queue_depth", 5.0)
+    tel.counter("fit_samples", 10)
+    tel.flush()   # file on disk, but no summary event written
+    tel.reset()
+    tel._enabled = False
+    merged = agg.aggregate(agg.rank_files(base))
+    assert merged["per_rank"][1]["has_summary"] is False
+    assert merged["counters"]["fit_samples"] == 10
+    assert merged["skew"]["step"]["ranks"][1]["count"] == 1
+    # the dead rank's span durations joined the bucket merge
+    assert merged["histograms"]["step"]["count"] == 2
+    assert merged["histograms"]["step"]["max"] == pytest.approx(
+        30000.0, rel=0.01)   # µs
+    assert merged["histograms"]["queue_depth"]["count"] == 1
+
+
+def test_rebuild_hist_matches_telemetry_export():
+    """The agg tool's stdlib bucket-scheme copy stays in lockstep with
+    mxnet_tpu.telemetry: rebuilding from raw values reproduces the
+    exporter's histogram exactly (same bound keys, counts, stats)."""
+    agg = _load_tool("telemetry_agg")
+    vals = [float(v) for v in RS(11).uniform(0.01, 1e6, 300)]
+    vals += [0.0, -1.0, 1e11, float("nan")]   # under/overflow + non-finite
+    tel.start()
+    for v in vals:
+        tel.histogram("x", v)
+    exported = tel.histograms()["x"]
+    tel.stop()
+    assert agg.rebuild_hist(vals) == exported
+    assert agg.rebuild_hist([float("nan")]) is None
+
+
+def test_rank_files_ignores_stale_base(tmp_path):
+    """A leftover single-process file (no .rankN suffix) must not join a
+    multi-process merge — it would shift every real rank's label and fold
+    stale data into the fleet totals."""
+    agg = _load_tool("telemetry_agg")
+    base = str(tmp_path / "t.jsonl")
+    _write_rank_files(base, {0: 10.0, 1: 30.0})
+    Path(base).write_text("")   # stale single-process leftover
+    files = agg.rank_files(base)
+    assert [agg.rank_of(p) for p in files] == [0, 1]
+    merged = agg.aggregate(files)
+    assert merged["skew"]["step"]["straggler"] == 1
+    # without rank files the bare base is still usable
+    solo = str(tmp_path / "solo.jsonl")
+    tel.start(solo)
+    tel.counter("c", 1)
+    tel.stop()
+    assert agg.rank_files(solo) == [solo]
+
+
+# ------------------------------------------------------------- live endpoint
+def test_endpoint_serves_prometheus_and_json():
+    tel.start()
+    tel.counter("requests", 7)
+    tel.gauge("temp", 21.5)
+    tel.gauge("device_live_bytes[TFRT_CPU_0]", 1024)
+    for v in (100.0, 200.0, 400.0):
+        tel.histogram("lat", v)
+    port = ms.start_server(0)
+    assert port and ms.server_port() == port
+    assert any(t.name == "mxtpu-metrics" for t in threading.enumerate())
+
+    text = _http_get(port, "/metrics")
+    assert "# TYPE mxtpu_requests_total counter" in text
+    assert "mxtpu_requests_total 7" in text
+    assert "mxtpu_temp 21.5" in text
+    assert "mxtpu_device_live_bytes_TFRT_CPU_0 1024.0" in text
+    assert "# TYPE mxtpu_lat histogram" in text
+    assert 'mxtpu_lat_bucket{le="+Inf"} 3' in text
+    assert "mxtpu_lat_sum 700.0" in text and "mxtpu_lat_count 3" in text
+    # cumulative bucket counts are monotone and end at the total
+    cums = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+            if line.startswith("mxtpu_lat_bucket")]
+    assert cums == sorted(cums) and cums[-1] == 3
+
+    # a counter and a span histogram that sanitize to the same family name
+    # (dist_allreduce vs dist.allreduce) must not emit two conflicting
+    # # TYPE lines — Prometheus drops the whole scrape on that
+    tel.counter("dist_allreduce")
+    tel.record_span("dist.allreduce", time.time(), 0.001, mirror=False)
+    text = _http_get(port, "/metrics")
+    families = [line.split()[2] for line in text.splitlines()
+                if line.startswith("# TYPE")]
+    assert len(families) == len(set(families))
+    assert "# TYPE mxtpu_dist_allreduce_total counter" in text
+    assert "# TYPE mxtpu_dist_allreduce histogram" in text
+
+    doc = json.loads(_http_get(port, "/metrics.json"))
+    assert doc["recording"] is True
+    assert doc["counters"]["requests"] == 7
+    assert doc["histograms"]["lat"]["count"] == 3
+    assert doc["histograms"]["lat"]["quantiles"]["p99"] == pytest.approx(
+        400.0, rel=0.1)
+    assert _http_get(port, "/healthz").strip() == "ok"
+
+    ms.stop_server()
+    assert ms.server_port() is None
+    with pytest.raises(Exception):
+        _http_get(port, "/healthz")
+
+
+def test_endpoint_rank_offset_and_autostart(monkeypatch):
+    base = _free_port()
+    monkeypatch.setenv("MXNET_METRICS_PORT", str(base))
+    monkeypatch.setenv("MXTPU_PROCESS_ID", "1")
+    assert ms._autostart() is True
+    try:
+        # launch contract: rank N serves on base+N, and the rank rides
+        # every exposed metric as a label
+        assert ms.server_port() == base + 1
+        # autostart with MXNET_TELEMETRY unset began an in-memory session
+        assert tel.enabled()
+        tel.counter("c", 2)
+        text = _http_get(base + 1, "/metrics")
+        assert 'mxtpu_c_total{rank="1"} 2' in text
+        doc = json.loads(_http_get(base + 1, "/metrics.json"))
+        assert doc["rank"] == "1"
+    finally:
+        ms.stop_server()
+
+
+def test_endpoint_bad_env_degrades(monkeypatch):
+    monkeypatch.setenv("MXNET_METRICS_PORT", "not-a-port")
+    with pytest.warns(UserWarning, match="metrics endpoint disabled"):
+        assert ms._autostart() is False
+    assert ms.server_port() is None
+    monkeypatch.setenv("MXNET_METRICS_PORT", "0")
+    assert ms._autostart() is False
+    assert not tel.enabled()
+
+
+def test_endpoint_bind_address(monkeypatch):
+    """MXNET_METRICS_PORT accepts <port> or <host>:<port>; the default
+    bind is loopback so a fit's internals are not network-visible unless
+    asked."""
+    assert ms._parse_endpoint("9100") == ("127.0.0.1", 9100)
+    assert ms._parse_endpoint("0.0.0.0:9100") == ("0.0.0.0", 9100)
+    assert ms._parse_endpoint("myhost:8080") == ("myhost", 8080)
+    with pytest.raises(ValueError):
+        ms._parse_endpoint("myhost:")
+    with pytest.raises(ValueError):
+        ms._parse_endpoint("nope")
+    # env-driven start binds the host part; default is loopback
+    port = _free_port()
+    monkeypatch.setenv("MXNET_METRICS_PORT", "127.0.0.1:%d" % port)
+    monkeypatch.delenv("MXTPU_PROCESS_ID", raising=False)
+    tel.start()
+    try:
+        assert ms.start_server() == port
+        assert ms._server.server_address[0] == "127.0.0.1"
+        assert _http_get(port, "/healthz").strip() == "ok"
+    finally:
+        ms.stop_server()
+
+
+# ------------------------------------------------------- launcher propagation
+def test_launch_propagates_observability_env(monkeypatch):
+    launch = _load_tool("launch")
+    monkeypatch.setenv("MXNET_TELEMETRY", "/tmp/t.jsonl")
+    monkeypatch.setenv("MXNET_METRICS_PORT", "9100")
+    monkeypatch.setenv("MXNET_WATCHDOG_SEC", "300")
+    monkeypatch.setenv("MXNET_DIAG_DIR", "/tmp/diag")
+    monkeypatch.delenv("MXNET_CHECK_NUMERICS", raising=False)
+    obs = launch.observability_env()
+    assert obs == {"MXNET_TELEMETRY": "/tmp/t.jsonl",
+                   "MXNET_METRICS_PORT": "9100",
+                   "MXNET_WATCHDOG_SEC": "300",
+                   "MXNET_DIAG_DIR": "/tmp/diag"}
+
+    captured = []
+
+    class _FakeProc:
+        def __init__(self, cmd, env=None, **kw):
+            captured.append((cmd, env))
+
+        def poll(self):
+            return 0
+
+        def wait(self):
+            return 0
+
+        def kill(self):
+            pass
+
+    monkeypatch.setattr(launch.subprocess, "Popen", _FakeProc)
+    assert launch.launch_local(2, ["true"]) == 0
+    for _, env in captured:
+        # local workers get the launcher's full environment (base port
+        # verbatim: the per-rank offset lives in metrics_server); ssh
+        # workers below need the explicit observability_env() forwarding
+        assert env["MXNET_METRICS_PORT"] == "9100"
+        assert env["MXNET_TELEMETRY"] == "/tmp/t.jsonl"
+    assert {e["MXTPU_PROCESS_ID"] for _, e in captured} == {"0", "1"}
+
+    captured.clear()
+    assert launch.launch_ssh(["hostA", "hostB"], ["train.py"]) == 0
+    for cmd, _ in captured:
+        remote = cmd[-1]   # "cd ... && env K=V ... command"
+        assert "MXNET_METRICS_PORT=9100" in remote
+        assert "MXNET_TELEMETRY=/tmp/t.jsonl" in remote
+        assert "MXNET_WATCHDOG_SEC=300" in remote
+
+
+# ----------------------------------------------------------- predictor/bench
+def test_predictor_telemetry_counters_and_span():
+    from mxnet_tpu.predictor import Predictor
+    pred = Predictor(_small_net(), {}, {"data": (4, 6)})
+    x = RS(0).rand(4, 6).astype(np.float32)
+    # disabled path first: no counters, no histograms
+    pred.set_input("data", x)
+    pred.forward()
+    assert tel.counters() == {} and tel.histograms() == {}
+    tel.start()
+    pred.set_input("data", x)
+    pred.forward()
+    pred.forward()
+    c = tel.counters()
+    h = tel.histograms()
+    p99 = tel.quantile("predict.forward", 0.99)
+    tel.stop()
+    assert c["predict_requests"] == 2
+    assert c["predict_samples"] == 8
+    assert h["predict.forward"]["count"] == 2
+    assert h["predict.set_input"]["count"] == 1
+    assert p99 is not None and p99 > 0
+
+
+def test_bench_telemetry_summary():
+    spec = importlib.util.spec_from_file_location("bench", ROOT / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert bench.telemetry_summary() is None   # telemetry off
+    tel.start()
+    t = time.time()
+    for i, ms_ in enumerate((10.0, 11.0, 12.0, 13.0)):
+        tel.record_span("step", t, ms_ / 1e3, cat="step", nbatch=i,
+                        mirror=False)
+        tel.record_span("data_wait", t, ms_ / 1e4, cat="step", nbatch=i,
+                        mirror=False)
+    tel.histogram("bench.step", 5000.0)
+    s = bench.telemetry_summary()
+    assert s["step"]["count"] == 4
+    assert s["step"]["mean_ms"] == pytest.approx(11.5, rel=0.01)
+    assert s["step"]["p99_ms"] == pytest.approx(13.0, rel=0.1)
+    assert s["bench.step"]["p50_ms"] == pytest.approx(5.0, rel=0.1)
+    assert s["data_wait_share"] == pytest.approx(0.1, rel=0.05)
+
+
+# ---------------------------------------------------- zero-overhead default
+def test_everything_off_guard(tmp_path):
+    """With all observability env unset: no server thread, no socket, no
+    recording, no histogram work — and the entry points stay no-ops."""
+    for var in ("MXNET_TELEMETRY", "MXNET_METRICS_PORT", "MXNET_DIAG_DIR",
+                "MXNET_WATCHDOG_SEC"):
+        assert var not in os.environ
+    assert ms._autostart() is False
+    assert ms.server_port() is None
+    assert not any(t.name == "mxtpu-metrics" for t in threading.enumerate())
+    assert not tel.enabled()
+    tel.histogram("h", 1.0)
+    with tel.span("s", cat="x"):
+        pass
+    tel.record_span("s", time.time(), 0.001)
+    assert tel.histograms() == {} and tel.quantile("s", 0.5) is None
+    assert tel.counters() == {} and tel.events() == []
+    assert list(tmp_path.iterdir()) == []
+
+
+# ------------------------------------------------------------ end-to-end e2e
+@pytest.mark.slow
+@pytest.mark.timeout(300)
+def test_launch_local_fleet_e2e(tmp_path):
+    """The acceptance path: a 2-process launch_local synthetic fit serves
+    live Prometheus text on both rank-offset ports mid-run; afterwards the
+    merged rank files name the artificially slowed rank as the straggler."""
+    import subprocess
+    import sys
+    agg = _load_tool("telemetry_agg")
+    child = tmp_path / "child.py"
+    child.write_text("""
+import os, sys, time
+sys.path.insert(0, %r)
+import numpy as np
+import mxnet_tpu as mx
+
+rank = int(os.environ["MXTPU_PROCESS_ID"])
+x = np.random.RandomState(0).rand(60, 6).astype(np.float32)
+y = np.random.RandomState(1).randint(0, 4, 60).astype(np.float32)
+it = mx.io.NDArrayIter(x, y, batch_size=10)
+data = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+net = mx.sym.SoftmaxOutput(net, name="softmax")
+mod = mx.Module(net, context=mx.cpu(),
+                data_names=("data",), label_names=("softmax_label",))
+
+def slow_rank(param):
+    time.sleep(0.15 if rank == 1 else 0.01)
+
+mod.fit(it, num_epoch=8, batch_end_callback=slow_rank,
+        optimizer_params={"learning_rate": 0.1})
+print("OK rank", rank)
+""" % str(ROOT))
+    base_port = _free_port()
+    tfile = str(tmp_path / "telemetry.jsonl")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_TELEMETRY"] = tfile
+    env["MXNET_METRICS_PORT"] = str(base_port)
+    proc = subprocess.Popen(
+        [sys.executable, str(ROOT / "tools" / "launch.py"), "-n", "2",
+         sys.executable, str(child)],
+        env=env, cwd=str(ROOT), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    live = {}
+    try:
+        deadline = time.time() + 240
+        while time.time() < deadline and len(live) < 2:
+            if proc.poll() is not None:
+                break
+            for rank in (0, 1):
+                if rank in live:
+                    continue
+                try:
+                    text = _http_get(base_port + rank, "/metrics")
+                except Exception:
+                    continue
+                # an empty exposition means the endpoint is up but the
+                # first step hasn't landed yet — keep scraping
+                if "# TYPE" in text:
+                    live[rank] = text
+            time.sleep(0.2)
+        out, err = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, (out[-2000:], err[-4000:])
+    assert out.count("OK rank") == 2
+    # both rank-offset ports served Prometheus text DURING the run
+    assert set(live) == {0, 1}, "endpoints never came up mid-run"
+    for rank, text in live.items():
+        assert 'rank="%d"' % rank in text
+        assert "# TYPE" in text
+    # post-mortem fleet merge names rank 1 as the straggler
+    files = agg.rank_files(tfile)
+    assert len(files) == 2
+    merged = agg.aggregate(files)
+    assert merged["histograms"]["step"]["count"] > 0
+    rep = merged["skew"]["step"]
+    assert rep["slowest_rank"] == 1 and rep["straggler"] == 1

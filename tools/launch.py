@@ -37,6 +37,23 @@ def _free_port():
     return port
 
 
+# Observability env vars forwarded to every worker explicitly by launch_ssh,
+# which builds a fresh env on the remote side (nothing inherits there);
+# launch_local workers receive the launcher's full os.environ, which already
+# carries these keys.  MXNET_METRICS_PORT propagates as the BASE endpoint
+# verbatim: the per-rank offset (rank N serves on port+N) is applied by
+# mxnet_tpu.metrics_server itself from MXTPU_PROCESS_ID, so the offset
+# logic lives in exactly one place.
+OBSERVABILITY_ENV = ("MXNET_TELEMETRY", "MXNET_TELEMETRY_FUSED",
+                     "MXNET_METRICS_PORT", "MXNET_DIAG_DIR",
+                     "MXNET_WATCHDOG_SEC", "MXNET_CHECK_NUMERICS")
+
+
+def observability_env():
+    """The observability contract present in this launcher's environment."""
+    return {k: os.environ[k] for k in OBSERVABILITY_ENV if k in os.environ}
+
+
 def launch_local(n, command, env_extra=None, max_restarts=0):
     """Run n copies of `command` locally with the MXTPU_* env contract.
 
@@ -97,9 +114,10 @@ def launch_ssh(hosts, command, env_extra=None):
     coord = "%s:%d" % (hosts[0], port)
     procs = []
     for rank, host in enumerate(hosts):
-        env = {"MXTPU_COORDINATOR": coord,
-               "MXTPU_NUM_PROCESSES": str(len(hosts)),
-               "MXTPU_PROCESS_ID": str(rank)}
+        env = observability_env()
+        env.update({"MXTPU_COORDINATOR": coord,
+                    "MXTPU_NUM_PROCESSES": str(len(hosts)),
+                    "MXTPU_PROCESS_ID": str(rank)})
         env.update(env_extra or {})
         env_str = " ".join("%s=%s" % (k, shlex.quote(v))
                            for k, v in env.items())

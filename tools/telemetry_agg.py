@@ -1,0 +1,434 @@
+#!/usr/bin/env python
+"""Cross-rank telemetry aggregation + straggler detection.
+
+A multi-process run under the MXTPU_* launch contract (tools/launch.py)
+writes one telemetry JSON-lines file per rank (``<path>.rank<N>`` — see
+``MXNET_TELEMETRY`` in docs/env_var.md).  This tool merges them into one
+fleet view:
+
+* **counters** are summed across ranks (``fit_samples`` becomes the global
+  sample count),
+* **histograms** are bucket-merged (bounds are fixed and identical across
+  ranks, so the merge is an associative per-bound count sum) and reported
+  as p50/p90/p99,
+* **gauges** stay per-rank (a last-value-wins metric has no meaningful
+  cross-rank sum),
+
+and computes per-rank skew over the latency-critical spans (``step``,
+``dist.allreduce`` by default): per-rank count/mean/p50/p99 from the raw
+span durations, the slowest rank, and the skew ratio (slowest mean over
+the median mean of the other ranks).  A ratio above ``--straggler-ratio``
+(default 1.25) flags the straggler — the rank every collective waits for.
+
+Usage:
+    python tools/telemetry_agg.py /tmp/t.jsonl          # base: globs .rank*
+    python tools/telemetry_agg.py /tmp/t.jsonl.rank0 /tmp/t.jsonl.rank1
+    python tools/telemetry_agg.py /tmp/t.jsonl --json   # machine-readable
+
+Pure stdlib (usable offline, away from the training image); also imported
+as a library by ``tools/telemetry_report.py --ranks``.  Histogram quantile
+estimation and MERGING need no bucket-scheme knowledge — the exported
+format is self-describing (sparse ``{upper_bound: count}`` plus the bucket
+ratio).  Rebuilding a summary-less rank's histograms from its raw stream
+(a killed or still-live rank never ran ``telemetry.stop()``) does need the
+scheme, so this module carries a stdlib copy of it alongside
+``quantile_from_hist``; a unit test holds the two implementations together.
+"""
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import math
+import os
+import re
+import sys
+from collections import defaultdict
+
+SKEW_SPANS = ("step", "dist.allreduce")
+STRAGGLER_RATIO = 1.25
+
+# span-fed histograms and span durations are microseconds (telemetry.py)
+_US_PER_MS = 1e3
+
+
+# ------------------------------------------------------------------- loading
+def load_events(path):
+    """Parse one JSON-lines file; a partial trailing line (live run) is
+    ignored."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue
+    return events
+
+
+def rank_of(path):
+    """Rank from the launch-contract filename suffix, else None."""
+    m = re.search(r"\.rank(\d+)$", path)
+    return int(m.group(1)) if m else None
+
+
+def rank_files(base):
+    """Per-rank files of one run: ``base.rank*``, rank-sorted.  The bare
+    ``base`` (a single-process run writes no suffix) is used only when NO
+    rank files exist — a leftover single-process file must not join a
+    multi-process merge, where it would shift every real rank's label and
+    fold a stale run's data into the fleet totals."""
+    files = sorted((p for p in _glob.glob(_glob.escape(base) + ".rank*")
+                    if rank_of(p) is not None),
+                   key=rank_of)
+    if not files and os.path.exists(base):
+        return [base]
+    return files
+
+
+def fold_rank(events):
+    """One rank's {counters, gauges, histograms, span_durs}.  Prefers the
+    run's summary event; a file without one (run still live, or killed)
+    folds counters/gauges from the raw stream and REBUILDS its histograms
+    from the span durations and explicit ``hist`` events, so a dead rank —
+    in a straggler investigation, exactly the rank whose latency matters —
+    still contributes to the merged fleet view.  ``span_durs`` (raw span
+    durations per name, µs) always comes from the stream — it is the
+    exact-percentile source for the skew tables."""
+    counters, gauges, hists, has_summary = {}, {}, {}, False
+    for ev in reversed(events):
+        if ev.get("type") == "summary":
+            counters = dict(ev.get("counters", {}))
+            gauges = dict(ev.get("gauges", {}))
+            hists = dict(ev.get("histograms", {}))
+            has_summary = True
+            break
+    span_durs = defaultdict(list)
+    hist_vals = defaultdict(list)
+    for ev in events:
+        t = ev.get("type")
+        if t == "span":
+            span_durs[ev["name"]].append(ev.get("dur", 0.0))
+        elif not has_summary:
+            if t == "counter":
+                counters[ev["name"]] = ev.get("total", 0)
+            elif t == "gauge":
+                gauges[ev["name"]] = ev.get("value")
+            elif t == "hist":
+                hist_vals[ev["name"]].append(ev.get("value", 0.0))
+    if not has_summary:
+        # span closes feed their histogram without a separate hist event
+        # (telemetry.record_span), so the rebuild sources are span durs
+        # plus the explicit histogram() observations
+        for name, durs in span_durs.items():
+            hist_vals[name] = list(durs) + hist_vals.get(name, [])
+        hists = {name: h for name, h in
+                 ((n, rebuild_hist(vs)) for n, vs in hist_vals.items())
+                 if h is not None}
+    return {"counters": counters, "gauges": gauges, "histograms": hists,
+            "span_durs": dict(span_durs), "has_summary": has_summary}
+
+
+# ------------------------------------------------------- histogram rebuild
+# Stdlib copy of mxnet_tpu.telemetry's fixed bucket scheme (20 buckets per
+# decade, finite upper bounds 10**-1 .. 10**10, overflow bucket beyond) —
+# held in lockstep by test_fleet_observability.  Needed only to rebuild a
+# summary-less rank's histograms; merging and quantiles stay scheme-free.
+_HIST_PER_DECADE = 20
+_HIST_MIN_EXP = -1
+_HIST_MAX_EXP = 10
+_HIST_NFINITE = (_HIST_MAX_EXP - _HIST_MIN_EXP) * _HIST_PER_DECADE
+_HIST_RATIO = 10.0 ** (1.0 / _HIST_PER_DECADE)
+
+
+def _hist_bound(index):
+    if index > _HIST_NFINITE:
+        return float("inf")
+    return 10.0 ** (_HIST_MIN_EXP + index / _HIST_PER_DECADE)
+
+
+def _hist_index(value):
+    if value <= 10.0 ** _HIST_MIN_EXP:
+        return 0
+    if value > 10.0 ** _HIST_MAX_EXP:
+        return _HIST_NFINITE + 1
+    idx = int(math.ceil((math.log10(value) - _HIST_MIN_EXP)
+                        * _HIST_PER_DECADE))
+    return min(max(idx, 1), _HIST_NFINITE)
+
+
+def rebuild_hist(values):
+    """Exported-format histogram from raw observations — what
+    ``telemetry.stop()`` would have written had the rank lived to run it.
+    Bucket keys use the same ``%.6g`` bound formatting as the exporter so
+    the result merges cleanly with real summary histograms.  Returns None
+    when no finite observation exists."""
+    finite = [float(v) for v in values if math.isfinite(float(v))]
+    if not finite:
+        return None
+    buckets = {}
+    for v in finite:
+        b = _hist_bound(_hist_index(v))
+        key = "inf" if math.isinf(b) else "%.6g" % b
+        buckets[key] = buckets.get(key, 0) + 1
+    return {"count": len(finite), "sum": sum(finite), "min": min(finite),
+            "max": max(finite), "ratio": _HIST_RATIO, "buckets": buckets}
+
+
+# ------------------------------------------------------------------- merging
+def merge_histograms(a, b):
+    """Bucket-merge two exported histograms (same fixed bounds across all
+    processes ⇒ a per-bound count sum — associative and commutative)."""
+    if a is None:
+        return dict(b)
+    buckets = dict(a.get("buckets", {}))
+    for k, n in b.get("buckets", {}).items():
+        buckets[k] = buckets.get(k, 0) + n
+    return {
+        "count": a.get("count", 0) + b.get("count", 0),
+        "sum": a.get("sum", 0.0) + b.get("sum", 0.0),
+        "min": min(a.get("min"), b.get("min")),
+        "max": max(a.get("max"), b.get("max")),
+        "ratio": a.get("ratio") or b.get("ratio"),
+        "buckets": buckets,
+    }
+
+
+def quantile_from_hist(h, q):
+    """Stdlib copy of mxnet_tpu.telemetry.quantile_from_hist (kept in
+    lockstep by test_fleet_observability)."""
+    count = h.get("count", 0)
+    if not count:
+        return None
+    q = min(max(float(q), 0.0), 1.0)
+    lo_all = h.get("min")
+    hi_all = h.get("max")
+    ratio = h.get("ratio") or 10.0 ** 0.05
+    entries = sorted(((float("inf") if k == "inf" else float(k), n)
+                      for k, n in h.get("buckets", {}).items()),
+                     key=lambda kv: kv[0])
+    target = q * count
+    cum = 0
+    for i, (bound, n) in enumerate(entries):
+        if cum + n < target and i < len(entries) - 1:
+            cum += n
+            continue
+        if math.isinf(bound):
+            lo = entries[i - 1][0] if i else lo_all
+            hi = hi_all
+        else:
+            lo = lo_all if (i == 0 and lo_all is not None) else bound / ratio
+            hi = bound
+        if hi_all is not None:
+            hi = min(hi, hi_all)
+        if lo_all is not None:
+            lo = min(max(lo, lo_all), hi)
+        frac = (target - cum) / n if n else 1.0
+        frac = min(max(frac, 0.0), 1.0)
+        if lo <= 0 or hi <= 0:
+            return lo + (hi - lo) * frac
+        return lo * (hi / lo) ** frac
+    return hi_all
+
+
+def percentile(values, q):
+    """Exact linear-interpolation percentile (numpy 'linear' method) of a
+    list of raw values."""
+    if not values:
+        return None
+    vals = sorted(values)
+    pos = (len(vals) - 1) * min(max(float(q), 0.0), 1.0)
+    lo = int(math.floor(pos))
+    hi = int(math.ceil(pos))
+    return vals[lo] + (vals[hi] - vals[lo]) * (pos - lo)
+
+
+def merge_ranks(per_rank):
+    """{rank: fold_rank dict} → fleet view: summed counters, bucket-merged
+    histograms, per-rank gauges."""
+    counters = defaultdict(int)
+    hists = {}
+    gauges = {}
+    for rank in sorted(per_rank):
+        st = per_rank[rank]
+        for name, v in st["counters"].items():
+            counters[name] += v
+        for name, h in st["histograms"].items():
+            hists[name] = merge_histograms(hists.get(name), h)
+        gauges[rank] = st["gauges"]
+    return {"counters": dict(counters), "histograms": hists,
+            "gauges_by_rank": gauges}
+
+
+# ----------------------------------------------------------- straggler skew
+def skew_table(per_rank, name):
+    """Per-rank latency stats for span ``name`` from raw durations (µs):
+    {rank: {count, mean, p50, p99}}; ranks without the span are absent."""
+    table = {}
+    for rank, st in per_rank.items():
+        durs = st["span_durs"].get(name)
+        if not durs:
+            continue
+        table[rank] = {"count": len(durs),
+                       "mean": sum(durs) / len(durs),
+                       "p50": percentile(durs, 0.50),
+                       "p99": percentile(durs, 0.99)}
+    return table
+
+
+def straggler_report(per_rank, names=SKEW_SPANS, ratio=STRAGGLER_RATIO):
+    """Skew analysis over the latency-critical spans: for each span
+    present on ≥1 rank, the per-rank table, the slowest rank by mean, and
+    the skew ratio (slowest mean / median mean of the other ranks).
+    ``straggler`` is set when ≥2 ranks disagree by more than ``ratio``."""
+    report = {}
+    for name in names:
+        table = skew_table(per_rank, name)
+        if not table:
+            continue
+        means = sorted((rec["mean"], rank) for rank, rec in table.items())
+        slowest_mean, slowest_rank = means[-1]
+        # skew against the median of the OTHER ranks — "the straggler is
+        # Nx the typical rank", which stays meaningful at world size 2
+        rest = [m for m, _ in means[:-1]] or [slowest_mean]
+        median_mean = percentile(rest, 0.5)
+        skew = slowest_mean / median_mean if median_mean else float("inf")
+        report[name] = {
+            "ranks": table,
+            "slowest_rank": slowest_rank,
+            "skew_ratio": skew,
+            "straggler": slowest_rank if (len(table) >= 2 and skew >= ratio)
+            else None,
+        }
+    return report
+
+
+# ----------------------------------------------------------------- top level
+def aggregate(paths, skew_spans=SKEW_SPANS, ratio=STRAGGLER_RATIO):
+    """Load + merge a set of per-rank files.  Files without a rank suffix
+    get sequential pseudo-ranks so single-file input still renders."""
+    per_rank = {}
+    for path in paths:
+        rank = rank_of(path)
+        if rank is None or rank in per_rank:
+            rank = 0
+            while rank in per_rank:
+                rank += 1
+        per_rank[rank] = fold_rank(load_events(path))
+        per_rank[rank]["path"] = path
+    merged = merge_ranks(per_rank)
+    merged["ranks"] = sorted(per_rank)
+    merged["skew"] = straggler_report(per_rank, names=skew_spans,
+                                      ratio=ratio)
+    merged["per_rank"] = per_rank
+    return merged
+
+
+def render(agg, out=sys.stdout):
+    ranks = agg["ranks"]
+    out.write("Fleet telemetry: %d rank file(s) (%s)\n"
+              % (len(ranks), ", ".join("rank%s" % r for r in ranks)))
+    live = [r for r in ranks if not agg["per_rank"][r]["has_summary"]]
+    if live:
+        out.write("note: no summary event for rank(s) %s — run still live "
+                  "or killed; totals and histograms rebuilt from the raw "
+                  "stream\n"
+                  % ", ".join(str(r) for r in live))
+
+    hists = agg["histograms"]
+    if hists:
+        out.write("\nLatency histograms (bucket-merged; recorded in µs, "
+                  "shown in ms)\n")
+        out.write("%-20s %8s %10s %10s %10s %10s\n"
+                  % ("name", "count", "p50_ms", "p90_ms", "p99_ms",
+                     "max_ms"))
+        for name in sorted(hists):
+            h = hists[name]
+            qs = [quantile_from_hist(h, q) for q in (0.50, 0.90, 0.99)]
+            out.write("%-20s %8d %10.3f %10.3f %10.3f %10.3f\n"
+                      % ((name, h["count"])
+                         + tuple((v or 0.0) / _US_PER_MS for v in qs)
+                         + (h["max"] / _US_PER_MS,)))
+
+    for name, rep in agg["skew"].items():
+        out.write("\nPer-rank skew — span '%s'\n" % name)
+        out.write("%6s %8s %10s %10s %10s\n"
+                  % ("rank", "n", "mean_ms", "p50_ms", "p99_ms"))
+        for rank in sorted(rep["ranks"]):
+            rec = rep["ranks"][rank]
+            out.write("%6s %8d %10.3f %10.3f %10.3f\n"
+                      % (rank, rec["count"], rec["mean"] / _US_PER_MS,
+                         rec["p50"] / _US_PER_MS, rec["p99"] / _US_PER_MS))
+        verdict = "STRAGGLER" if rep["straggler"] is not None else "ok"
+        out.write("  slowest rank: %s (%.2fx the median of the other "
+                  "ranks) — %s\n"
+                  % (rep["slowest_rank"], rep["skew_ratio"], verdict))
+
+    counters = agg["counters"]
+    if counters:
+        out.write("\nCounters (summed across ranks)\n")
+        for name in sorted(counters):
+            out.write("  %-24s %s\n" % (name, counters[name]))
+
+    gauges = agg["gauges_by_rank"]
+    shown = sorted({n for g in gauges.values() for n in g})
+    if shown:
+        out.write("\nGauges (per rank)\n")
+        for name in shown:
+            vals = ", ".join("rank%s=%s" % (r, gauges[r][name])
+                             for r in sorted(gauges) if name in gauges[r])
+            out.write("  %-24s %s\n" % (name, vals))
+
+
+def _strip_per_rank(agg):
+    """The --json view: drop the bulky raw-duration lists, keep the stats."""
+    out = {k: v for k, v in agg.items() if k != "per_rank"}
+    out["files"] = {r: agg["per_rank"][r]["path"] for r in agg["ranks"]}
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="per-rank telemetry files, or ONE base path "
+                         "(expands to <base>.rank* per the launch contract)")
+    ap.add_argument("--span", action="append", default=None,
+                    help="additional span name(s) for the skew analysis "
+                         "(default: %s)" % ", ".join(SKEW_SPANS))
+    ap.add_argument("--straggler-ratio", type=float, default=STRAGGLER_RATIO,
+                    help="flag a straggler when slowest/median rank mean "
+                         "exceeds this (default %(default)s)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged view as one JSON document")
+    args = ap.parse_args(argv)
+    paths = list(args.paths)
+    if len(paths) == 1 and rank_of(paths[0]) is None:
+        paths = rank_files(paths[0])
+        if not paths:
+            sys.stderr.write("telemetry_agg: no files match %s[.rank*]\n"
+                             % args.paths[0])
+            return 1
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        sys.stderr.write("telemetry_agg: cannot read %s\n"
+                         % ", ".join(missing))
+        return 1
+    spans = tuple(SKEW_SPANS) + tuple(args.span or ())
+    agg = aggregate(paths, skew_spans=spans, ratio=args.straggler_ratio)
+    if args.json:
+        json.dump(_strip_per_rank(agg), sys.stdout, indent=1, default=str)
+        sys.stdout.write("\n")
+    else:
+        render(agg)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

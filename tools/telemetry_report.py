@@ -24,6 +24,13 @@ traceback: an unreadable path exits 1; a file whose steps never completed
 (no ``step`` spans) or that lacks a summary event (the run never called
 ``telemetry.stop()``) says so and renders what it can.
 
+With ``--ranks`` the path is treated as the base of a multi-process run
+(``MXNET_TELEMETRY`` under tools/launch.py writes ``<path>.rank<N>`` per
+worker): the per-rank files are globbed and the fleet view — counters
+summed, latency histograms bucket-merged, per-rank skew columns and the
+straggler verdict — is rendered via the aggregation library
+(tools/telemetry_agg.py) instead of the single-file breakdown.
+
 Pure stdlib; safe to point at a file from a live run (partial last line is
 ignored).
 """
@@ -225,9 +232,24 @@ def render_health(counters, gauges, compile_spans, out):
                   "MXNET_TELEMETRY plus the diagnostics env vars)\n")
 
 
+def _agg_lib():
+    """The cross-rank aggregation library, loaded from this directory
+    (tools/ is not a package) — one parser/merger implementation shared
+    between the two CLIs."""
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "telemetry_agg.py")
+    spec = importlib.util.spec_from_file_location("telemetry_agg", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="telemetry JSON-lines file")
+    ap.add_argument("path", help="telemetry JSON-lines file (with --ranks: "
+                                 "the base path of a multi-process run)")
     ap.add_argument("--steps", action="store_true",
                     help="also print the per-step table")
     ap.add_argument("--epoch", type=int, default=None,
@@ -235,7 +257,25 @@ def main(argv=None):
     ap.add_argument("--health", action="store_true",
                     help="also print the training-health section "
                          "(non-finite / compile / memory signals)")
+    ap.add_argument("--ranks", action="store_true",
+                    help="merge <path>.rank* into the fleet view (summed "
+                         "counters, bucket-merged histograms, per-rank "
+                         "skew + straggler report); the bare <path> is "
+                         "used only when no rank files exist")
     args = ap.parse_args(argv)
+    if args.ranks and (args.health or args.steps or args.epoch is not None):
+        ap.error("--ranks renders the fleet view only; --health/--steps/"
+                 "--epoch apply to a single-rank report (run them against "
+                 "one <path>.rankN file)")
+    if args.ranks:
+        agg = _agg_lib()
+        files = agg.rank_files(args.path)
+        if not files:
+            sys.stderr.write("telemetry_report: no files match %s[.rank*]\n"
+                             % args.path)
+            return 1
+        agg.render(agg.aggregate(files))
+        return 0
     try:
         events = load_events(args.path)
     except (OSError, UnicodeDecodeError) as e:
