@@ -98,14 +98,36 @@ def telemetry_summary():
     return out or None
 
 
+def run_meta(config):
+    """Run identity stamped into the emitted JSON: the benchmark config,
+    the launch-contract world size/rank, and — when telemetry is recording
+    — the path of this process's event/scalar stream.  That last field is
+    what lets ``tools/run_compare.py`` chain from a BENCH_*.json record to
+    the training curves behind it (same-directory relative paths are
+    resolved against the BENCH file)."""
+    from mxnet_tpu import telemetry as tel
+    from mxnet_tpu.base import get_env
+    meta = {
+        "config": dict(config),
+        "world_size": int(get_env("MXTPU_PROCESS_COUNT", 1)),
+        "rank": get_env("MXTPU_PROCESS_ID"),
+    }
+    path = tel.sink_path()
+    if path:
+        meta["telemetry_scalars"] = path
+    return meta
+
+
 def main():
-    img_per_sec = bench_resnet50_train()
+    cfg = dict(batch=32, image=224, chunk=40, rounds=10, dtype="bfloat16")
+    img_per_sec = bench_resnet50_train(**cfg)
     baseline_p100 = 181.53
     rec = {
         "metric": "resnet50_train_img_per_sec_b32",
         "value": round(img_per_sec, 2),
         "unit": "img/s",
         "vs_baseline": round(img_per_sec / baseline_p100, 3),
+        "meta": run_meta(cfg),
     }
     summary = telemetry_summary()
     if summary:

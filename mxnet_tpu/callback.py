@@ -95,7 +95,9 @@ class Speedometer(object):
     meter stays consistent with the telemetry stream.  The counter is
     process-global: if several modules fit concurrently in one process,
     each meter reads their COMBINED throughput (loops that never advance
-    the counter fall back to batch-index arithmetic).
+    the counter fall back to batch-index arithmetic).  Each reported rate
+    is also published as a ``throughput`` scalar (telemetry.scalar), so
+    the logged number and the recorded training curve are one value.
     """
 
     def __init__(self, batch_size, frequent=50):
@@ -125,13 +127,25 @@ class Speedometer(object):
             return
         span = max(now - self._mark[2], 1e-12)
         delta = pos - self._mark[1]
-        if delta <= 0 or src != self._mark[3]:
+        stale = delta <= 0 or src != self._mark[3]
+        if stale:
             # the counter didn't advance across this window (a loop that
             # doesn't feed fit_samples, e.g. score()), or telemetry toggled
             # mid-window so the two positions have different sources —
             # fall back to batch-index arithmetic
             delta = (n - self._mark[0]) * self.batch_size
         rate = delta / span
+        if _tel.enabled():
+            # the same number that is about to be logged, as a curve point
+            # — the logged line and the recorded history can never
+            # disagree.  Step axis: the fit loop's global batch counter
+            # when it is feeding (nbatch resets every epoch and would
+            # fold the curve back on itself).  When the counter is stale
+            # the driving loop isn't the fit loop (score()/eval), so the
+            # frozen fit_batches value would pile every report onto one
+            # step — use the loop's own batch index instead.
+            gb = None if stale else _tel.value("fit_batches")
+            _tel.scalar("throughput", gb - 1 if gb else n, rate)
         pairs = _metric_pairs(param.eval_metric)
         if pairs:
             param.eval_metric.reset()

@@ -272,6 +272,9 @@ def snapshot(reason, exc=None, extra=None):
             "counters": _tel.counters(),
             "gauges": _tel.gauges(),
             "histograms": _tel.histograms(),
+            # last training-curve points: a crash/stall bundle then shows
+            # where the loss/lr/grad norms stood when the run died
+            "scalars": _tel.scalars(),
             "recent_events": _tel.recent_events(RECENT_EVENTS),
         },
     }

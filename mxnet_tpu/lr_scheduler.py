@@ -13,9 +13,20 @@ from __future__ import annotations
 
 import logging
 
+from . import telemetry as _tel
+
 __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler"]
 
 _LOG = logging.getLogger(__name__)
+
+
+def _record_decay(lr, num_update):
+    """Publish an ``lr`` scalar at a decay boundary.  The fit loop samples
+    its per-step ``lr`` point by MXNET_SCALARS_EVERY — the one step where
+    the rate actually CHANGES is exactly the point sampling must never
+    drop, so schedulers pin it into the curve themselves."""
+    if _tel._enabled:
+        _tel.scalar("lr", num_update, lr)
 
 
 class LRScheduler(object):
@@ -74,6 +85,7 @@ class FactorScheduler(LRScheduler):
             else:
                 _LOG.info("lr schedule: %.5e after %d decay(s) "
                           "(update %d)", lr, k, num_update)
+            _record_decay(lr, num_update)
         return lr
 
 
@@ -121,4 +133,5 @@ class MultiFactorScheduler(LRScheduler):
             self._last_logged = k
             _LOG.info("lr schedule: %.5e after boundary %d of %d "
                       "(update %d)", lr, k, len(self.step), num_update)
+            _record_decay(lr, num_update)
         return lr

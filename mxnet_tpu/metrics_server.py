@@ -128,6 +128,17 @@ def json_snapshot():
         "counters": _tel.counters(),
         "gauges": _tel.gauges(),
         "histograms": hists,
+        # last point of every training-curve series (train_loss, lr,
+        # grad_norm[param=...], ...) — "where is the loss right now"
+        # without touching the file stream.  Scalars record non-finite
+        # points by design (a NaN loss is the finding), but json.dumps
+        # would emit them as bare NaN/Infinity tokens no RFC-8259 parser
+        # accepts — stringify them so the endpoint stays scrapeable
+        # during exactly the incident it should surface
+        "scalars": {k: dict(s, value=s["value"]
+                            if math.isfinite(s["value"])
+                            else str(s["value"]))
+                    for k, s in _tel.scalars().items()},
     }
 
 

@@ -36,6 +36,19 @@ def _render(stat):
         for v in items)
 
 
+def _scalar_stat(stat):
+    """A stat result as a float when it is scalar-valued (a number, or a
+    size-1 NDArray like the default RMS), else None.  The NDArray branch
+    syncs one scalar — toc() is already a sync point (_drain_pending),
+    and the Monitor's ``interval`` bounds how often this runs."""
+    if isinstance(stat, (int, float)):
+        return float(stat)
+    if isinstance(stat, NDArray) and stat.size == 1:
+        import numpy as _np
+        return float(_np.asarray(stat.asnumpy()).reshape(-1)[0])
+    return None
+
+
 def _stat_nonfinite(stat):
     """True if any element of a stat result is NaN/Inf (sentinel hook;
     the dtype/finiteness policy lives in diagnostics)."""
@@ -114,6 +127,17 @@ class Monitor(object):
         self._rows = []
         if self.sort:
             rows.sort(key=lambda row: row[1])
+        from . import telemetry as _tel
+        if _tel._enabled:
+            # per-tensor stats become plottable history, not print-only:
+            # scalar-valued rows flow into the telemetry scalar stream as
+            # one `monitor` series per tensor.  Monitor's own step counter
+            # never resets, so it is a clean curve axis; list-valued /
+            # non-scalar stats stay display-only.
+            for step, name, stat in rows:
+                v = _scalar_stat(stat)
+                if v is not None:
+                    _tel.scalar("monitor", step, v, tensor=name)
         from . import diagnostics as _diag
         mode = _diag.check_numerics_mode()
         if mode is not None:

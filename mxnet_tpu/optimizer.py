@@ -13,13 +13,24 @@ import pickle
 
 import numpy as np
 
-from .base import MXNetError, Registry, string_types
+from .base import MXNetError, Registry, get_env, string_types
 from . import ndarray as nd
+from . import telemetry as _tel
 from .ndarray import NDArray
 
 __all__ = ["Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "DCASGD", "Adam",
            "AdaGrad", "RMSProp", "AdaDelta", "Test", "Updater", "create",
-           "get_updater", "register"]
+           "get_updater", "register", "opt_stats_enabled"]
+
+
+def opt_stats_enabled():
+    """True when ``MXNET_OPT_STATS=1`` opts the update path into optimizer
+    introspection: per-parameter-group ``grad_norm`` / ``weight_norm`` /
+    ``update_ratio`` scalars recorded by the ``Updater`` around each
+    update (docs/observability.md).  Requires telemetry to be recording;
+    sampled by ``MXNET_SCALARS_EVERY`` like every per-step producer.  Read
+    live (not cached) so tests and long-lived processes can toggle it."""
+    return get_env("MXNET_OPT_STATS") in ("1", "true", "True")
 
 _OPTIMIZERS = Registry("optimizer")
 
@@ -410,7 +421,57 @@ class Updater(object):
     def __call__(self, index, grad, weight):
         if index not in self.states:
             self.states[index] = self.optimizer.create_state(index, weight)
-        self.optimizer.update(index, weight, grad, self.states[index])
+        if _tel._enabled and opt_stats_enabled():
+            # jax arrays are immutable — every update rebinds weight.value,
+            # so holding the pre-update array is a reference, not a copy
+            w0 = getattr(weight, "value", None)
+            self.optimizer.update(index, weight, grad, self.states[index])
+            if w0 is not None:
+                self._record_stats(index, w0, weight, grad)
+        else:
+            self.optimizer.update(index, weight, grad, self.states[index])
+
+    def _record_stats(self, index, w0, weight, grad):
+        """MXNET_OPT_STATS introspection: per-parameter-group gradient
+        norm, pre-update weight norm, and update-to-weight ratio
+        ``‖w₁−w₀‖/‖w₀‖`` — the standard "is the step size sane" signal
+        (≫1e-2: lr too hot; ≪1e-5: layer effectively frozen).  All three
+        reduce ON DEVICE in float32 and cross to the host as one stacked
+        3-scalar fetch per group (same scalar-only-sync discipline as the
+        diagnostics sentinel); ``scalar_due`` gates the whole computation
+        so MXNET_SCALARS_EVERY bounds the syncs.  The gradient is the raw
+        one handed to the optimizer (before rescale_grad/clipping).
+
+        Step axis: the 0-based update index within this run
+        (``num_update - 1 - begin_num_update``) — in the standard fit
+        loop that equals the fit's global batch step even on a
+        checkpoint resume (where ``begin_num_update > 0`` but the fit's
+        own counter restarts at 0), so the grad/weight-norm points land
+        on the SAME sampled steps as the ``train_<metric>`` points they
+        are read against (phase-aligned sampling also means one set of
+        sync steps, not two)."""
+        opt = self.optimizer
+        step = opt.num_update - 1 - opt.begin_num_update
+        if not _tel.scalar_due(step):
+            return
+        g = getattr(grad, "value", None)
+        w1 = weight.value
+        if g is None or not hasattr(w0, "dtype"):
+            return
+        import jax.numpy as jnp
+        import numpy as _np
+        f32 = jnp.float32
+        norms = jnp.sqrt(jnp.stack([
+            jnp.sum(jnp.square(g.astype(f32))),
+            jnp.sum(jnp.square(w0.astype(f32))),
+            jnp.sum(jnp.square(w1.astype(f32) - w0.astype(f32)))]))
+        gn, wn, up = (float(x) for x in _np.asarray(norms))
+        name = opt.idx2name.get(index, str(index))
+        _tel.scalar("grad_norm", step, gn, param=name)
+        _tel.scalar("weight_norm", step, wn, param=name)
+        _tel.scalar("update_ratio", step,
+                    up / wn if wn else (0.0 if up == 0 else float("inf")),
+                    param=name)
 
     def set_states(self, states):
         self.states = pickle.loads(states)

@@ -14,7 +14,14 @@ all of them: a process-wide, thread-safe registry of
   estimation (``histogram(name, value)``); every span close also feeds a
   latency histogram of the same name automatically, so tail latency for
   ``step``, ``forward``, ``dist.allreduce``, ``predict.forward``, ... is
-  always available while recording,
+  always available while recording, and
+* **scalars**    — per-step time-series points (``scalar(name, step,
+  value)``): ``train_loss``, ``lr``, ``grad_norm``, ``throughput``, ...
+  — the training-curve leg of the stack.  ``MXNET_SCALARS_EVERY=N``
+  samples the per-step producers (fit metrics, optimizer introspection)
+  down to every N-th step via ``scalar_due(step)`` so the device syncs
+  those values cost stay bounded; ``tools/run_compare.py`` aligns the
+  recorded curves across runs,
 
 exported as JSON-lines events.  Every span is also forwarded to
 ``profiler.record_event`` so the chrome-trace output and the JSON-lines
@@ -44,9 +51,10 @@ from collections import deque
 from .base import get_env
 
 __all__ = ["start", "stop", "enabled", "span", "record_span", "counter",
-           "gauge", "histogram", "value", "counters", "gauges",
-           "histograms", "quantile", "quantile_from_hist", "hist_bound",
-           "events", "recent_events", "flush", "reset"]
+           "gauge", "histogram", "scalar", "scalar_due", "value",
+           "counters", "gauges", "histograms", "scalars", "quantile",
+           "quantile_from_hist", "hist_bound", "events", "recent_events",
+           "flush", "reset", "sink_path"]
 
 _lock = threading.RLock()
 _enabled = False
@@ -55,6 +63,8 @@ _buffer = deque()     # pending event dicts (drained to _path on flush)
 _counters = {}
 _gauges = {}
 _histograms = {}      # name -> [count, sum, min, max, {bucket_index: n}]
+_scalars = {}         # series key -> [n, last_step, last_value]
+_scalars_every = 1    # MXNET_SCALARS_EVERY, re-read at every start()
 _atexit_armed = False
 _FLUSH_EVERY = 1024   # buffered events before an automatic file flush
 _BUFFER_CAP = 262144  # in-memory mode: drop oldest beyond this
@@ -73,7 +83,7 @@ def start(path=None):
     sink; without it events stay in memory (``events()``), capped at
     ``_BUFFER_CAP``.  Any state left by a previous session (buffered
     events, counter totals) is cleared — one session per file."""
-    global _enabled, _path, _atexit_armed, _dropped
+    global _enabled, _path, _atexit_armed, _dropped, _scalars_every
     with _lock:
         if path:
             open(path, "w").close()   # truncate: one run per file
@@ -82,8 +92,17 @@ def start(path=None):
         _counters.clear()
         _gauges.clear()
         _histograms.clear()
+        _scalars.clear()
         _dropped = 0
         _path = path
+        try:
+            _scalars_every = max(1, int(get_env("MXNET_SCALARS_EVERY", 1)))
+        except (TypeError, ValueError):
+            import warnings
+            warnings.warn("MXNET_SCALARS_EVERY=%r is not an integer; "
+                          "recording every step"
+                          % get_env("MXNET_SCALARS_EVERY"))
+            _scalars_every = 1
         if path and not _atexit_armed:
             atexit.register(stop)
             _atexit_armed = True
@@ -102,6 +121,10 @@ def stop():
         if _histograms:
             summary["histograms"] = {name: _hist_export(h)
                                      for name, h in _histograms.items()}
+        if _scalars:
+            summary["scalars"] = {k: {"n": s[0], "step": s[1],
+                                      "value": s[2]}
+                                  for k, s in _scalars.items()}
         if _dropped:
             # in-memory cap evicted the run's oldest events — say so
             summary["dropped_events"] = _dropped
@@ -119,7 +142,18 @@ def reset():
         _counters.clear()
         _gauges.clear()
         _histograms.clear()
+        _scalars.clear()
         _dropped = 0
+
+
+def sink_path():
+    """Path of the JSON-lines sink of the current session (None while
+    disabled or recording in memory) — lets a run stamp WHERE its event/
+    scalar stream went into artifacts it emits (bench.py writes it into
+    BENCH_*.json so ``tools/run_compare.py`` can chain from the benchmark
+    record to its training curves)."""
+    with _lock:
+        return _path if _enabled else None
 
 
 def _emit_locked(ev):
@@ -347,6 +381,69 @@ def quantile_from_hist(h, q):
             return lo + (hi - lo) * frac
         return lo * (hi / lo) ** frac
     return hi_all
+
+
+# ------------------------------------------------------------------ scalars
+def series_key(name, tags=None):
+    """Display/series key of a scalar: the bare name, or ``name[k=v,...]``
+    when tags distinguish several series under one name (``grad_norm``
+    per parameter group, ``monitor`` per tensor).  ``tools/run_compare.py``
+    carries a stdlib copy so offline curve alignment builds the SAME keys."""
+    if not tags:
+        return name
+    return "%s[%s]" % (name, ",".join("%s=%s" % (k, tags[k])
+                                      for k in sorted(tags)))
+
+
+def scalar_due(step):
+    """True when per-step scalar producers should record ``step`` — the
+    sampling gate behind ``MXNET_SCALARS_EVERY=N`` (default 1: every
+    step).  Producers whose values cost a device sync (fit metric values,
+    optimizer introspection) check this BEFORE computing, so the knob
+    bounds syncs, not just file volume.  Producers with their own cadence
+    (Speedometer ``frequent``, Monitor ``interval``, epoch-end rollups,
+    lr decay boundaries) emit directly — decimating those would drop the
+    few points that matter most."""
+    return _enabled and int(step) % _scalars_every == 0
+
+
+def scalar(name, step, value, **tags):
+    """Record one time-series point: ``value`` of series ``name`` at
+    integer ``step``.  Append-only into the same per-rank JSON-lines
+    stream as every other event (``type: "scalar"``); the registry keeps
+    only the last value per series (no per-point memory growth), exported
+    with the summary event.  Non-finite values are RECORDED — unlike
+    histogram observations, a NaN in a loss curve is the finding, and
+    consumers (``run_compare``, ``--curves``) handle it.  Strict no-op
+    while disabled."""
+    if not _enabled:
+        return
+    step = int(step)
+    value = float(value)
+    ev = {"type": "scalar", "name": name, "ts": time.time() * 1e6,
+          "step": step, "value": value}
+    if tags:
+        ev["tags"] = tags
+    key = series_key(name, tags)
+    with _lock:
+        if not _enabled:
+            return
+        s = _scalars.get(key)
+        if s is None:
+            _scalars[key] = [1, step, value]
+        else:
+            s[0] += 1
+            s[1] = step
+            s[2] = value
+        _emit_locked(ev)
+
+
+def scalars():
+    """Snapshot of every scalar series' last recorded point:
+    ``{series_key: {"n": points, "step": last_step, "value": last}}``."""
+    with _lock:
+        return {k: {"n": s[0], "step": s[1], "value": s[2]}
+                for k, s in _scalars.items()}
 
 
 def value(name, default=None):

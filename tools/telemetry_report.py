@@ -17,7 +17,11 @@ path).  This tool groups those spans per step and prints:
   kvstore traffic, io batches, ...),
 * with ``--health``: the training-health signals recorded by the
   diagnostics layer (non-finite counters, XLA compile cost per jit kind,
-  jit-cache size, device-memory gauges — docs/observability.md).
+  jit-cache size, device-memory gauges — docs/observability.md),
+* with ``--curves``: every scalar time-series in the file
+  (``train_<metric>``, ``lr``, ``throughput``, ``grad_norm[param=...]``,
+  ...) as a terminal sparkline with first/last/min/max — the quick look
+  before reaching for ``tools/run_compare.py``.
 
 Files it cannot summarise produce a clear one-line message, never a
 traceback: an unreadable path exits 1; a file whose steps never completed
@@ -38,6 +42,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 from collections import defaultdict
 
@@ -177,6 +182,71 @@ def render_counters(counters, out):
         out.write("  %-24s %s\n" % (name, counters[name]))
 
 
+# --------------------------------------------------------------- curves view
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def collect_scalars(events):
+    """{series_key: [(step, value)] sorted} from the scalar events.  Key
+    construction comes from tools/run_compare.py (the stdlib copy that is
+    lockstep-tested against telemetry.series_key) — one implementation,
+    same ``name[k=v,...]`` keys in the curves view and the comparison."""
+    series_key = _sibling("run_compare").series_key
+    series = {}
+    for ev in events:
+        if ev.get("type") != "scalar" or "step" not in ev:
+            continue
+        key = series_key(ev["name"], ev.get("tags"))
+        series.setdefault(key, []).append((ev["step"], ev["value"]))
+    return {k: sorted(v) for k, v in series.items()}
+
+
+def sparkline(values, width=48):
+    """Block-character sparkline, mean-downsampled to ``width`` columns.
+    Non-finite points render as ``!`` — a NaN in a curve must be seen,
+    not interpolated away."""
+    if len(values) > width:
+        cells, per = [], len(values) / float(width)
+        for i in range(width):
+            chunk = values[int(i * per):max(int((i + 1) * per),
+                                            int(i * per) + 1)]
+            finite = [v for v in chunk if math.isfinite(v)]
+            cells.append(sum(finite) / len(finite) if finite
+                         else float("nan"))
+    else:
+        cells = list(values)
+    finite = [v for v in cells if math.isfinite(v)]
+    if not finite:
+        return "!" * len(cells)
+    lo, hi = min(finite), max(finite)
+    span = (hi - lo) or 1.0
+    return "".join(
+        "!" if not math.isfinite(v)
+        else _SPARK[min(int((v - lo) / span * (len(_SPARK) - 1) + 0.5),
+                        len(_SPARK) - 1)]
+        for v in cells)
+
+
+def render_curves(series, out):
+    """Training-curves section: one sparkline + summary row per scalar."""
+    out.write("\nScalars (training curves)\n")
+    if not series:
+        out.write("  no scalar events (fit curves need MXNET_TELEMETRY; "
+                  "see telemetry.scalar / MXNET_SCALARS_EVERY)\n")
+        return
+    out.write("  %-34s %5s %10s %10s %10s %10s\n"
+              % ("series", "n", "first", "last", "min", "max"))
+    for key in sorted(series):
+        pts = series[key]
+        vals = [v for _, v in pts]
+        finite = [v for v in vals if math.isfinite(v)]
+        out.write("  %-34s %5d %10.5g %10.5g %10.5g %10.5g\n"
+                  % (key, len(vals), vals[0], vals[-1],
+                     min(finite) if finite else float("nan"),
+                     max(finite) if finite else float("nan")))
+        out.write("    %s\n" % sparkline(vals))
+
+
 # --------------------------------------------------------------- health view
 _NONFINITE = ["nonfinite_loss", "nonfinite_grad", "nonfinite_monitor"]
 _INCIDENTS = ["fit_crashes", "watchdog_stalls"]
@@ -232,18 +302,22 @@ def render_health(counters, gauges, compile_spans, out):
                   "MXNET_TELEMETRY plus the diagnostics env vars)\n")
 
 
-def _agg_lib():
-    """The cross-rank aggregation library, loaded from this directory
-    (tools/ is not a package) — one parser/merger implementation shared
-    between the two CLIs."""
+def _sibling(name):
+    """Load a sibling tool as a library (tools/ is not a package) — how
+    this CLI shares one implementation with telemetry_agg (fleet merge)
+    and run_compare (series keys)."""
     import importlib.util
     import os
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "telemetry_agg.py")
-    spec = importlib.util.spec_from_file_location("telemetry_agg", path)
+                        "%s.py" % name)
+    spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _agg_lib():
+    return _sibling("telemetry_agg")
 
 
 def main(argv=None):
@@ -257,16 +331,20 @@ def main(argv=None):
     ap.add_argument("--health", action="store_true",
                     help="also print the training-health section "
                          "(non-finite / compile / memory signals)")
+    ap.add_argument("--curves", action="store_true",
+                    help="also print every scalar time-series as a "
+                         "terminal sparkline (training curves)")
     ap.add_argument("--ranks", action="store_true",
                     help="merge <path>.rank* into the fleet view (summed "
                          "counters, bucket-merged histograms, per-rank "
                          "skew + straggler report); the bare <path> is "
                          "used only when no rank files exist")
     args = ap.parse_args(argv)
-    if args.ranks and (args.health or args.steps or args.epoch is not None):
+    if args.ranks and (args.health or args.steps or args.curves or
+                       args.epoch is not None):
         ap.error("--ranks renders the fleet view only; --health/--steps/"
-                 "--epoch apply to a single-rank report (run them against "
-                 "one <path>.rankN file)")
+                 "--curves/--epoch apply to a single-rank report (run "
+                 "them against one <path>.rankN file)")
     if args.ranks:
         agg = _agg_lib()
         files = agg.rank_files(args.path)
@@ -292,6 +370,8 @@ def main(argv=None):
     if args.health:
         render_health(counters, gauges, collect_compile_spans(events),
                       sys.stdout)
+    if args.curves:
+        render_curves(collect_scalars(events), sys.stdout)
     return 0
 
 
