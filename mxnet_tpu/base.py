@@ -11,7 +11,7 @@ import os
 import threading
 
 __all__ = ["MXNetError", "string_types", "numeric_types", "get_env", "check",
-           "Registry", "classproperty"]
+           "Registry", "classproperty", "TRACE_ENV_DEFAULTS", "trace_env_key"]
 
 string_types = (str,)
 numeric_types = (float, int)
@@ -37,6 +37,27 @@ def get_env(name, default=None, typ=None):
     if typ is not None:
         return typ(val)
     return val
+
+
+# Env flags whose value is consulted while a computation is being traced
+# (executor layout/fusion passes, op formulation A/B levers).  Every jit
+# dispatch cache keys on trace_env_key() so toggling one of these between
+# calls retraces instead of silently reusing a program compiled under the
+# old value.  Adding a var here is the contract for reading it at trace
+# time; mxlint's JIT001 rule polices reads that bypass it.
+TRACE_ENV_DEFAULTS = (
+    ("MXNET_CONV_LAYOUT", "NHWC"),
+    ("MXNET_NORM_CONV", "0"),
+    ("MXNET_STEM_FUSE", "1"),
+    ("MXNET_STEM_S2D", "0"),
+    ("MXNET_POOL_MASK_BWD", "0"),
+    ("MXNET_PALLAS_CONV", "auto"),
+)
+
+
+def trace_env_key():
+    """Snapshot of the trace-affecting env flags, for jit cache keys."""
+    return tuple(get_env(n, d) for n, d in TRACE_ENV_DEFAULTS)
 
 
 def smart_open(uri, mode="rb"):

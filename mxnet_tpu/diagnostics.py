@@ -158,6 +158,10 @@ def _watch_loop():
             age = time.monotonic() - last
             if age < _watchdog_sec:
                 continue
+            # GIL-atomic bool flip; heartbeat()'s lock-free reset is the
+            # hot-path contract (it must never contend with a dump in
+            # progress) and at worst costs one extra bundle
+            # mxlint: disable=THR001 GIL-atomic publication, see above
             _stall_handled = True
             path = write_snapshot("watchdog_stall",
                                   extra={"stall_sec": age,

@@ -295,7 +295,8 @@ class _Lowered(object):
             values[(id(node), 1)] = s
             values[(id(node), 2)] = q
 
-    def _stem_run(self, node, values, nhwc, aux_updates, skip, arg_vals):
+    def _stem_run(self, node, values, nhwc, aux_updates, skip, arg_vals,
+                  s2d=False):
         """Run a fused input-BN + conv pair (see stem_fuse in __init__)."""
         import jax.numpy as jnp
         from .ops.nn import input_bn_conv
@@ -317,7 +318,7 @@ class _Lowered(object):
             w = arg_vals[wvar.name]
         out, mean, var = input_bn_conv(x_cl, beta, w, info["eps"],
                                        info["kernel"], info["stride"],
-                                       info["pad"])
+                                       info["pad"], s2d=s2d)
         mom = jnp.float32(info["momentum"])
         for pos, stat in ((3, mean), (4, var)):
             child = node.inputs[pos][0]
@@ -360,6 +361,7 @@ class _Lowered(object):
         stem_on = (use_nhwc and is_train and not collect
                    and bool(self.stem_fuse) and no_grad_inputs
                    and get_env("MXNET_STEM_FUSE", "1") == "1")
+        stem_s2d = get_env("MXNET_STEM_S2D", "0") == "1"
         nc_pl = get_env("MXNET_PALLAS_CONV", "auto")
         nc_ctx = {}
         values = {}
@@ -394,7 +396,7 @@ class _Lowered(object):
                                         id(self.stem_fuse[id(node)]["conv"])
                                         in self.nc_conv)):
                 if self._stem_run(node, values, nhwc, aux_updates, skip,
-                                  arg_vals):
+                                  arg_vals, s2d=stem_s2d):
                     continue
             if nc_on and id(node) in self.nc_bn:
                 if self._nc_run_bn(node, values, nhwc, aux_updates, nc_ctx,
@@ -657,7 +659,7 @@ class Executor(object):
         # attention op lowers to shard_map over it), so it must key the cache:
         # toggling set_sequence_mesh would otherwise reuse stale lowerings
         from .parallel import mesh as mesh_mod
-        from .base import get_env
+        from .base import get_env, trace_env_key
         seq_mesh, seq_axis = mesh_mod.sequence_mesh()
         # mirror flags are read at trace time, so they key the cache too —
         # toggling MXNET_BACKWARD_DO_MIRROR after an OOM must take effect
@@ -667,13 +669,11 @@ class Executor(object):
                      None if seq_mesh is None else
                      (mesh_mod.mesh_cache_key(seq_mesh), seq_axis),
                      mirror_key,
-                     get_env("MXNET_CONV_LAYOUT", "NHWC"),
-                     # NormConv fusion flags are also read at trace time
-                     get_env("MXNET_NORM_CONV", "0"),
-                     get_env("MXNET_STEM_FUSE", "1"),
-                     get_env("MXNET_STEM_S2D", "0"),
-                     get_env("MXNET_POOL_MASK_BWD", "0"),
-                     get_env("MXNET_PALLAS_CONV", "auto"))
+                     # every env flag _Lowered.run consults while tracing
+                     # (layout/fusion passes, op A/B levers) — one shared
+                     # registry, base.TRACE_ENV_DEFAULTS, so a new lever
+                     # can't forget to key the cache
+                     trace_env_key())
         from . import telemetry as _tel
         fn = self._jit_cache.get(cache_key)
         if fn is not None:

@@ -411,12 +411,14 @@ def _pool_infer(attrs, in_shapes):
           attr_types={"kernel": parse_tuple, "stride": parse_tuple,
                       "pad": parse_tuple, "pool_type": parse_str,
                       "global_pool": parse_bool, "pooling_convention": parse_str,
-                      "layout": parse_str},
+                      "layout": parse_str, "mask_bwd": parse_bool},
           defaults={"stride": (), "pad": (), "pool_type": "max",
                     "global_pool": False, "pooling_convention": "valid"},
+          env_attrs={"mask_bwd": ("MXNET_POOL_MASK_BWD", "0")},
           infer_shape=_pool_infer, layout_rule="aware")
 def _pooling(data, kernel=None, stride=(), pad=(), pool_type="max",
-             global_pool=False, pooling_convention="valid", layout=None):
+             global_pool=False, pooling_convention="valid", layout=None,
+             mask_bwd=None):
     """N-D pooling via XLA reduce_window (parity: pooling-inl.h / pool.h)."""
     nd = data.ndim - 2
     sp_axes = tuple(range(1, 1 + nd)) if layout == "NHWC" \
@@ -450,10 +452,11 @@ def _pooling(data, kernel=None, stride=(), pad=(), pool_type="max",
             return jax.lax.reduce_window(data, jnp.iinfo(data.dtype).min,
                                          jax.lax.max, window, strides,
                                          padding)
-        from ..base import get_env
-        if not global_pool and get_env("MXNET_POOL_MASK_BWD", "0") == "1":
+        if not global_pool and mask_bwd:
             # equality-mask backward — the reference's unpool tie
-            # semantics (every tied max gets the gradient) as an opt-in.
+            # semantics (every tied max gets the gradient) as an opt-in
+            # (MXNET_POOL_MASK_BWD, resolved to the mask_bwd attr at
+            # dispatch time — never read while tracing).
             # Default OFF: on the v5e the fused elementwise formulation
             # measured ~0.5 ms/step SLOWER than XLA's native
             # select-and-scatter on the ResNet stem pool (b32 bench 2485
@@ -665,13 +668,13 @@ def _s2d_pack_input(y):
     return jnp.reshape(y, (n, h // 2, w_ // 2, 4 * c))
 
 
-def _stem_conv(y, w, geom):
+def _stem_conv(y, w, geom, s2d=False):
     """The stem convolution, via space-to-depth when eligible and enabled
-    (MXNET_STEM_S2D=1; default off — see the A/B note in docs/perf.md)."""
-    from ..base import get_env
+    (MXNET_STEM_S2D=1; default off — see the A/B note in docs/perf.md).
+    ``s2d`` is resolved by the caller at dispatch time (the env var is
+    never read while tracing — it keys the jit caches instead)."""
     k, s, p = geom
-    if get_env("MXNET_STEM_S2D", "0") == "1" and _s2d_eligible(y.shape,
-                                                               geom):
+    if s2d and _s2d_eligible(y.shape, geom):
         wp, pads = _s2d_pack_weights(w, geom)
         return jax.lax.conv_general_dilated(
             _s2d_pack_input(y), wp, window_strides=(1, 1),
@@ -682,7 +685,7 @@ def _stem_conv(y, w, geom):
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
-def _ibc_fwd_impl(x, b, w, eps, geom):
+def _ibc_fwd_impl(x, b, w, eps, geom, s2d):
     """Forward of the fused input BatchNorm(fix_gamma) + Convolution.
 
     ``x`` channel-last (N, H, W, C); ``w`` logical (O, C, kh, kw).
@@ -698,7 +701,7 @@ def _ibc_fwd_impl(x, b, w, eps, geom):
     shift = b.astype(acc) - mean * inv
     y = x * inv.reshape(cshape).astype(x.dtype) \
         + shift.reshape(cshape).astype(x.dtype)
-    out = _stem_conv(y, w, geom)
+    out = _stem_conv(y, w, geom, s2d)
     return out, mean, var, inv
 
 
@@ -713,8 +716,8 @@ def _ibc_tap_ranges(in_dim, out_dim, k, s, p):
     return ranges
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _input_bn_conv_core(x, b, w, eps, geom):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _input_bn_conv_core(x, b, w, eps, geom, s2d):
     """BatchNorm(train, fix_gamma) on a no-gradient input, fused with the
     consuming Convolution — the ResNet stem pattern (bn_data -> conv0,
     reference example/image-classification/symbol_resnet.py).
@@ -731,16 +734,16 @@ def _input_bn_conv_core(x, b, w, eps, geom):
     finishes the reduction.  d(x) is NOT produced (hard zero): the
     executor only fuses this pattern when the input is declared
     no-gradient."""
-    out, mean, var, _ = _ibc_fwd_impl(x, b, w, eps, geom)
+    out, mean, var, _ = _ibc_fwd_impl(x, b, w, eps, geom, s2d)
     return out, mean, var
 
 
-def _input_bn_conv_fwd(x, b, w, eps, geom):
-    out, mean, var, inv = _ibc_fwd_impl(x, b, w, eps, geom)
+def _input_bn_conv_fwd(x, b, w, eps, geom, s2d):
+    out, mean, var, inv = _ibc_fwd_impl(x, b, w, eps, geom, s2d)
     return (out, mean, var), (x, b, w, mean, inv)
 
 
-def _input_bn_conv_bwd(eps, geom, res, cts):
+def _input_bn_conv_bwd(eps, geom, s2d, res, cts):
     g, _dmean_ct, _dvar_ct = cts      # mean/var flow only to x (dropped)
     x, b, w, mean, inv = res
     k, s, p = geom
@@ -753,7 +756,7 @@ def _input_bn_conv_bwd(eps, geom, res, cts):
         + shift.reshape(cshape).astype(x.dtype)
 
     def conv_of_w(wt):
-        return _stem_conv(y, wt, geom)
+        return _stem_conv(y, wt, geom, s2d)
     _, w_vjp = jax.vjp(conv_of_w, w)
     dw = w_vjp(g)[0]
     # d(beta) = sum over the input grid of dgrad(g, w), computed without the
@@ -781,13 +784,15 @@ def _input_bn_conv_bwd(eps, geom, res, cts):
 _input_bn_conv_core.defvjp(_input_bn_conv_fwd, _input_bn_conv_bwd)
 
 
-def input_bn_conv(x_cl, beta, weight, eps, kernel, stride, pad):
+def input_bn_conv(x_cl, beta, weight, eps, kernel, stride, pad, s2d=False):
     """Executor entry point: fused train-mode input-BN + conv, channel-last.
     Returns (out_cl, mean, var) with mean/var in f32 for the moving-stat
-    update."""
+    update.  ``s2d`` is the caller-resolved MXNET_STEM_S2D lever (a static
+    nondiff arg of the custom VJP, so flipping it retraces)."""
     geom = (tuple(int(v) for v in kernel), tuple(int(v) for v in stride),
             tuple(int(v) for v in pad))
-    return _input_bn_conv_core(x_cl, beta, weight, float(eps), geom)
+    return _input_bn_conv_core(x_cl, beta, weight, float(eps), geom,
+                               bool(s2d))
 
 
 def _bn_infer(attrs, in_shapes):

@@ -1,0 +1,17 @@
+"""ENV001 seeded violations: bypassed choke point + doc drift (the md
+twin documents MXNET_FIXTURE_STALE with no reader and lists
+MXNET_FIXTURE_REFONLY as reference-parity while this file reads it)."""
+import os
+
+from somewhere import get_env
+
+# direct os.environ read bypassing base.get_env: finding
+_RAW = os.environ.get("MXNET_FIXTURE_RAW", "0")
+_SUB = os.environ["MXNET_FIXTURE_RAW"] if "MXNET_FIXTURE_RAW" in os.environ \
+    else "0"
+
+# read through get_env but documented nowhere: finding (undocumented)
+_MISSING = get_env("MXNET_FIXTURE_UNDOCUMENTED", "0")
+
+# live reader for a var the doc lists as reference-parity: finding
+_REF = get_env("MXNET_FIXTURE_REFONLY", "0")

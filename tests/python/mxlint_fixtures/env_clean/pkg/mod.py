@@ -1,0 +1,5 @@
+"""ENV001 clean twin: get_env choke point + two-way doc sync."""
+from somewhere import get_env
+
+_RAW = get_env("MXNET_FIXTURE_RAW", "0")
+_DOCUMENTED = get_env("MXNET_FIXTURE_DOCUMENTED", "0")

@@ -1,0 +1,15 @@
+"""Fixture base: the registered trace-env contract (rogue var absent)."""
+
+
+def get_env(name, default=None, typ=None):
+    import os
+    return os.environ.get(name, default)
+
+
+TRACE_ENV_DEFAULTS = (
+    ("MXNET_FIXTURE_LAYOUT", "NHWC"),
+)
+
+
+def trace_env_key():
+    return tuple(get_env(n, d) for n, d in TRACE_ENV_DEFAULTS)

@@ -1,0 +1,9 @@
+"""Trace-keyed file, but the var read is NOT in TRACE_ENV_DEFAULTS:
+the executor cache key misses it, so toggling never retraces -> finding."""
+from .base import get_env
+
+
+class _Lowered(object):
+    def run(self, values, is_train):
+        rogue = get_env("MXNET_FIXTURE_ROGUE", "0") == "1"
+        return [v * 2 if rogue else v for v in values]

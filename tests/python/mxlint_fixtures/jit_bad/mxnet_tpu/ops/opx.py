@@ -1,0 +1,11 @@
+"""Registered-op seeding: op bodies are traced by the executor/jit cache."""
+from ..base import get_env
+from .registry import register
+
+
+@register("FixtureOp")
+def _fixture_op(data):
+    # env read inside an op body: frozen at first compile -> finding
+    if get_env("MXNET_FIXTURE_OP_FLAG", "0") == "1":
+        return data * 2
+    return data

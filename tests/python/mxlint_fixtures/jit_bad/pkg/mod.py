@@ -1,0 +1,31 @@
+"""JIT001 seeded violations: impure work inside jit-traced code."""
+import time
+
+import jax
+
+from somewhere import get_env, telemetry
+
+_COUNT = 0
+
+
+@jax.jit
+def step(x):
+    flag = get_env("MXNET_FIXTURE_FLAG", "0")      # env read: finding
+    t0 = time.time()                               # clock read: finding
+    print("tracing", t0)                           # print: finding
+    telemetry.counter("steps")                     # telemetry: finding
+    return x * (1 if flag == "0" else 2)
+
+
+def _helper(x):
+    global _COUNT                                  # global decl: finding
+    _COUNT += 1
+    return x + _COUNT
+
+
+def outer(x):
+    # _helper is traced by propagation: jax.jit(outer) below
+    return _helper(x)
+
+
+fast_outer = jax.jit(outer)

@@ -1,0 +1,23 @@
+"""JIT001 clean twin: the same shapes with the impurity hoisted out."""
+import time
+
+import jax
+
+from somewhere import get_env, telemetry
+
+
+@jax.jit
+def step(x, doubled):
+    # the flag is resolved by the dispatching caller and passed in
+    jax.debug.print("per-call output {}", x)
+    return x * jax.numpy.where(doubled, 2, 1)
+
+
+def dispatch(x):
+    # env read, clock, and telemetry live OUTSIDE the traced body
+    flag = get_env("MXNET_FIXTURE_FLAG", "0")
+    t0 = time.time()
+    telemetry.counter("steps")
+    out = step(x, flag == "1")
+    telemetry.gauge("dispatch_sec", time.time() - t0)
+    return out

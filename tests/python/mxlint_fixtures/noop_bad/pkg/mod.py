@@ -1,0 +1,28 @@
+"""NOOP001 seeded violations: resource creation at import, no env gate."""
+import socket
+import threading
+
+
+def _loop():
+    while True:
+        pass
+
+
+# thread started unconditionally at import: finding
+_T = threading.Thread(target=_loop, daemon=True)
+
+# socket at import: finding
+_S = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+
+# file created at import: finding
+_LOG = open("/tmp/fixture.log", "w")
+
+
+def _autostart():
+    # reachable from module level below, body never consults the env,
+    # creates a thread: finding (via the reachability walk)
+    t = threading.Thread(target=_loop, daemon=True)
+    t.start()
+
+
+_autostart()

@@ -1,0 +1,31 @@
+"""NOOP001 clean twin: the telemetry.py/metrics_server.py autostart
+discipline — resource creation exists but every path is env-gated."""
+import os
+import socket
+import threading
+
+
+def _loop():
+    while True:
+        pass
+
+
+def _autostart():
+    # the early-return autostart pattern: the body reads the env first
+    if not os.environ.get("MXNET_FIXTURE_SERVE"):
+        return
+    t = threading.Thread(target=_loop, daemon=True)
+    t.start()
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    return s
+
+
+_autostart()
+
+if os.environ.get("MXNET_FIXTURE_LOG"):
+    _LOG = open("/tmp/fixture.log", "w")    # directly under an env guard
+
+if __name__ == "__main__":
+    # main-block work is not import-time work
+    t = threading.Thread(target=_loop, daemon=True)
+    t.start()

@@ -1,0 +1,17 @@
+"""SYNC001 seeded violations: unconditional host syncs on the hot path."""
+import numpy as np
+
+
+class TrainStep(object):
+    def __call__(self, params, batch):
+        loss, grads = self._step(params, batch)
+        self.last_loss = loss.item()            # ungated sync: finding
+        self.last_np = np.asarray(loss)         # ungated sync: finding
+        return float(loss), grads               # ungated sync: finding
+
+
+class EvalStep(object):
+    def __call__(self, params, batch):
+        out = self._fwd(params, batch)
+        out.block_until_ready()                 # ungated sync: finding
+        return out

@@ -1,0 +1,20 @@
+"""SYNC001 clean twin: syncs gated behind opt-in observability env vars."""
+import os
+
+from . import telemetry
+
+
+class TrainStep(object):
+    def __call__(self, params, batch):
+        loss, grads = self._step(params, batch)
+        if telemetry._enabled:
+            # bounded, documented cost of opting in
+            telemetry.scalar("train_loss", self.step, loss.item())
+        if os.environ.get("MXNET_CHECK_NUMERICS"):
+            self._check(float(loss))
+        return loss, grads                      # stays on device
+
+
+class EvalStep(object):
+    def __call__(self, params, batch):
+        return self._fwd(params, batch)         # caller decides when to sync

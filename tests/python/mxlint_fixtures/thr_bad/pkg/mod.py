@@ -1,0 +1,35 @@
+"""THR001 seeded violations: thread-written state accessed lock-free."""
+import threading
+
+
+class Worker(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            self.count += 1          # written on the thread, lock-free
+
+    def snapshot(self):
+        return self.count            # read lock-free elsewhere: finding
+
+
+_mod_lock = threading.Lock()
+_beats = 0
+
+
+def _loop():
+    global _beats
+    while True:
+        _beats += 1                  # module-scope twin of the same race
+
+
+def poll():
+    return _beats                    # lock-free read: finding
+
+
+def start():
+    t = threading.Thread(target=_loop, daemon=True)
+    t.start()

@@ -1,0 +1,44 @@
+"""THR001 clean twin: both sides hold the lock (plus one documented
+lock-free publication carrying an inline suppression)."""
+import threading
+
+
+class Worker(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.count += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self.count
+
+
+_mod_lock = threading.Lock()
+_beats = 0
+_done = False
+
+
+def _loop():
+    global _beats, _done
+    while True:
+        with _mod_lock:
+            _beats += 1
+    # mxlint: disable=THR001 GIL-atomic bool publication, single writer
+    _done = True
+
+
+def poll():
+    with _mod_lock:
+        return _beats
+    return _done
+
+
+def start():
+    t = threading.Thread(target=_loop, daemon=True)
+    t.start()

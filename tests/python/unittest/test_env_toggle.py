@@ -1,0 +1,155 @@
+"""Env A/B levers must take effect AFTER a prior jit compile.
+
+The hazard class (mxlint JIT001): an ``MXNET_*`` read inside a jit-traced
+body freezes the first-seen value into every cached program.  The fix has
+two prongs, each pinned here against its previously-frozen dispatch path:
+
+- ``OpDef.env_attrs``: ``MXNET_POOL_MASK_BWD`` resolves into the attr
+  dict at dispatch time, so the imperative jit cache
+  (``ops/registry._JIT_CACHE``) keys on the CURRENT value — before the
+  hoist, the first compile froze the flag for the process lifetime;
+- ``base.trace_env_key()``: every executor jit keys its cache on the
+  snapshot of ``base.TRACE_ENV_DEFAULTS``, so toggling e.g.
+  ``MXNET_STEM_S2D`` between calls retraces instead of reusing the stale
+  lowering (and the s2d lever genuinely selects a different program —
+  checked on the lowered HLO).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import registry
+
+
+def _tied_pool_grad():
+    """d(data) of sum(maxpool(x)) on an all-tied 2x2 window via the
+    imperative jit cache — the path that used to freeze the flag."""
+    op = registry.get_op("Pooling")
+    attrs = op.normalize_attrs({"kernel": (2, 2), "stride": (2, 2),
+                                "pool_type": "max"})
+    fn = registry.jitted(op, attrs, is_train=True)
+    x = jnp.zeros((1, 1, 2, 2), jnp.float32)
+    return np.asarray(jax.grad(lambda xx: jnp.sum(fn(xx)))(x))
+
+
+def test_pool_mask_bwd_toggle_after_compile_imperative(monkeypatch):
+    monkeypatch.delenv("MXNET_POOL_MASK_BWD", raising=False)
+    g_native = _tied_pool_grad()          # compiles with the flag OFF
+    assert (g_native != 0).sum() == 1     # select-and-scatter: first only
+
+    monkeypatch.setenv("MXNET_POOL_MASK_BWD", "1")
+    g_mask = _tied_pool_grad()            # must NOT reuse the stale program
+    assert (g_mask != 0).all(), g_mask    # reference ties: every max wins
+
+    monkeypatch.setenv("MXNET_POOL_MASK_BWD", "0")
+    g_back = _tied_pool_grad()            # and back again
+    assert (g_back != 0).sum() == 1
+
+
+def test_pool_mask_bwd_toggle_after_compile_executor(monkeypatch):
+    """Same toggle through ONE bound symbolic executor: the jit cache is
+    keyed by base.trace_env_key(), so the second backward retraces."""
+    monkeypatch.delenv("MXNET_POOL_MASK_BWD", raising=False)
+    net = mx.sym.Pooling(mx.sym.Variable("data"), kernel=(2, 2),
+                         stride=(2, 2), pool_type="max")
+    ex = net.simple_bind(mx.cpu(), data=(1, 1, 2, 2), grad_req="write")
+    x = mx.nd.zeros((1, 1, 2, 2))         # one all-tied window
+    head = mx.nd.ones((1, 1, 1, 1))
+
+    ex.forward(is_train=True, data=x)
+    ex.backward(head)
+    assert (ex.grad_dict["data"].asnumpy() != 0).sum() == 1
+
+    monkeypatch.setenv("MXNET_POOL_MASK_BWD", "1")
+    n_compiled = len(ex._jit_cache)
+    ex.forward(is_train=True, data=x)
+    ex.backward(head)
+    assert len(ex._jit_cache) > n_compiled        # toggle forced a retrace
+    g = ex.grad_dict["data"].asnumpy()
+    assert (g != 0).all(), g
+
+
+def test_stem_s2d_toggle_retraces_executor(monkeypatch):
+    """MXNET_STEM_S2D is numerically an A/B formulation (same outputs), so
+    'takes effect' here means: the executor retraces under the new key and
+    the results stay identical."""
+    monkeypatch.delenv("MXNET_STEM_S2D", raising=False)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.Flatten(mx.sym.Convolution(
+            mx.sym.BatchNorm(mx.sym.Variable("data"), fix_gamma=True,
+                             eps=2e-5, name="bn_data"),
+            num_filter=4, kernel=(7, 7), stride=(2, 2), pad=(3, 3),
+            no_bias=True, name="conv0")), name="softmax")
+    ex = net.simple_bind(mx.cpu(), data=(2, 3, 16, 16), softmax_label=(2,),
+                         grad_req={"data": "null", "softmax_label": "null",
+                                   "bn_data_gamma": "null",
+                                   "bn_data_beta": "write",
+                                   "conv0_weight": "write"})
+    rs = np.random.RandomState(0)
+    ex.arg_dict["bn_data_gamma"][:] = np.ones(3, np.float32)
+    ex.arg_dict["conv0_weight"][:] = \
+        rs.randn(4, 3, 7, 7).astype(np.float32) * 0.1
+    x = mx.nd.array(rs.rand(2, 3, 16, 16).astype(np.float32))
+    y = mx.nd.array(np.array([1.0, 0.0], np.float32))
+
+    def step():
+        ex.forward(is_train=True, data=x, softmax_label=y)
+        ex.backward()
+        return (ex.outputs[0].asnumpy().copy(),
+                ex.grad_dict["conv0_weight"].asnumpy().copy())
+
+    out0, dw0 = step()
+    n_compiled = len(ex._jit_cache)
+    out0b, _ = step()
+    assert len(ex._jit_cache) == n_compiled       # warm cache: no retrace
+
+    monkeypatch.setenv("MXNET_STEM_S2D", "1")
+    out1, dw1 = step()
+    assert len(ex._jit_cache) > n_compiled        # toggle keyed a retrace
+    np.testing.assert_allclose(out1, out0, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(dw1, dw0, rtol=1e-4, atol=1e-5)
+
+
+def test_stem_s2d_selects_a_different_program():
+    """The lever is not a no-op: on the eligible 7x7/s2 stem the s2d
+    lowering packs the input (4x channels, stride-1 conv), so the lowered
+    HLO differs from the direct strided conv."""
+    from mxnet_tpu.ops.nn import input_bn_conv
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(2, 16, 16, 3).astype(np.float32))
+    w = jnp.asarray(rs.rand(4, 3, 7, 7).astype(np.float32))
+    b = jnp.asarray(rs.rand(3).astype(np.float32))
+
+    def lowered(s2d):
+        fn = jax.jit(lambda xx, bb, ww: input_bn_conv(
+            xx, bb, ww, 2e-5, (7, 7), (2, 2), (3, 3), s2d=s2d))
+        return fn.lower(x, b, w).as_text()
+
+    direct, packed = lowered(False), lowered(True)
+    assert direct != packed
+    # the packed path convolves a 12-channel (4*3) space-to-depth input
+    assert "2,8,8,12" in packed.replace(" ", "") or "12" in packed
+    # and the two programs agree numerically
+    o0, m0, v0 = jax.jit(lambda: input_bn_conv(
+        x, b, w, 2e-5, (7, 7), (2, 2), (3, 3), s2d=False))()
+    o1, m1, v1 = jax.jit(lambda: input_bn_conv(
+        x, b, w, 2e-5, (7, 7), (2, 2), (3, 3), s2d=True))()
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o0),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_env_attr_explicit_wins_over_env(monkeypatch):
+    """An explicitly-passed attr beats the env lever (resolve_env_attrs
+    is a default-filler, not an override)."""
+    monkeypatch.setenv("MXNET_POOL_MASK_BWD", "1")
+    op = registry.get_op("Pooling")
+    attrs = op.normalize_attrs({"kernel": (2, 2), "stride": (2, 2),
+                                "pool_type": "max", "mask_bwd": False})
+    resolved = op.resolve_env_attrs(attrs)
+    assert resolved["mask_bwd"] is False
+    unset = op.normalize_attrs({"kernel": (2, 2), "stride": (2, 2),
+                                "pool_type": "max"})
+    assert op.resolve_env_attrs(unset)["mask_bwd"] is True

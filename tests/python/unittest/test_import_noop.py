@@ -1,0 +1,100 @@
+"""Dynamic twin of mxlint's NOOP001: ``import mxnet_tpu`` with every
+``MXNET_*`` / ``MXTPU_*`` env var unset is a strict no-op — no threads,
+no sockets, no files written.
+
+A subprocess installs a ``sys.addaudithook`` recorder (after pre-loading
+jax, so only this package's own import work is measured), imports the
+package plus every autostart-bearing module, and reports what was
+created.  The static rule proves no such call site exists without an env
+guard; this proves the guards actually hold at runtime.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_CHILD = r"""
+import json, sys
+
+import jax                      # pre-load: jax's import cost is not ours
+import numpy                    # (transitively loaded anyway)
+
+import threading
+baseline_threads = {t.ident for t in threading.enumerate()}
+
+created = {"socket": [], "file": [], "process": []}
+
+def _audit(name, args):
+    if name == "socket.__new__":
+        created["socket"].append(name)
+    elif name == "open":
+        path, mode = str(args[0]), args[1]
+        if isinstance(mode, str) and any(c in mode for c in "wax+"):
+            created["file"].append((path, mode))
+    elif name in ("subprocess.Popen", "os.posix_spawn", "os.fork"):
+        created["process"].append(name)
+
+sys.addaudithook(_audit)
+
+import mxnet_tpu
+import mxnet_tpu.telemetry
+import mxnet_tpu.metrics_server
+import mxnet_tpu.diagnostics
+import mxnet_tpu.profiler
+import mxnet_tpu.io
+import mxnet_tpu.image
+import mxnet_tpu.engine
+
+new_threads = [t.name for t in threading.enumerate()
+               if t.ident not in baseline_threads]
+print("RESULT " + json.dumps({"threads": new_threads, **created}))
+"""
+
+
+@pytest.mark.timeout(180)
+def test_import_with_env_unset_creates_no_resources(tmp_path):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("MXNET_", "MXTPU_"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in (env.get("PYTHONPATH"),) if p]
+        + [os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))])
+    proc = subprocess.run(
+        [sys.executable, "-B", "-c", _CHILD], cwd=str(tmp_path),
+        env=env, capture_output=True, text=True, timeout=150)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")]
+    assert line, proc.stdout + proc.stderr
+    result = json.loads(line[-1][len("RESULT "):])
+    assert result["threads"] == [], result
+    assert result["socket"] == [], result
+    assert result["file"] == [], result
+    assert result["process"] == [], result
+    # and nothing appeared in the working directory either
+    assert list(tmp_path.iterdir()) == []
+
+
+@pytest.mark.timeout(180)
+def test_import_with_opt_in_does_create_the_thread(tmp_path):
+    """The guard test's positive control: the SAME probe with one opt-in
+    env var set must see the watchdog thread — proving the recorder
+    actually detects what the no-op contract forbids."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("MXNET_", "MXTPU_"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_WATCHDOG_SEC"] = "60"
+    env["MXNET_DIAG_DIR"] = str(tmp_path)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in (env.get("PYTHONPATH"),) if p]
+        + [os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))])
+    proc = subprocess.run(
+        [sys.executable, "-B", "-c", _CHILD], cwd=str(tmp_path),
+        env=env, capture_output=True, text=True, timeout=150)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")]
+    result = json.loads(line[-1][len("RESULT "):])
+    assert "mxtpu-watchdog" in result["threads"], result

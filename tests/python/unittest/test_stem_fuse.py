@@ -55,10 +55,11 @@ def _unfused(x, b, w, eps, k, s, p):
         padding=[(p, p), (p, p)], dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
-@pytest.mark.parametrize("s2d", ["0", "1"])
+@pytest.mark.parametrize("s2d", [False, True])
 @pytest.mark.parametrize("geom", GEOMS)
-def test_dbeta_rectangle_sums_vs_autodiff(geom, s2d, f64, monkeypatch):
-    monkeypatch.setenv("MXNET_STEM_S2D", s2d)
+def test_dbeta_rectangle_sums_vs_autodiff(geom, s2d, f64):
+    # s2d is an explicit argument since the env hoist (the executor
+    # resolves MXNET_STEM_S2D at dispatch time and passes it down)
     h, k, s, p, cin, cout = geom
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randn(3, h, h, cin))
@@ -67,7 +68,8 @@ def test_dbeta_rectangle_sums_vs_autodiff(geom, s2d, f64, monkeypatch):
     eps = 2e-5
 
     def loss_fused(b_, w_):
-        out, _, _ = input_bn_conv(x, b_, w_, eps, (k, k), (s, s), (p, p))
+        out, _, _ = input_bn_conv(x, b_, w_, eps, (k, k), (s, s), (p, p),
+                                  s2d=s2d)
         return jnp.sum(out * jnp.cos(out))   # non-trivial head grad
 
     def loss_ref(b_, w_):

@@ -1,0 +1,27 @@
+"""mxlint — repo-native semantic lint for the mxnet_tpu codebase.
+
+Off-the-shelf linters check style; this one checks the *load-bearing
+invariants* this runtime is built on (docs/static_analysis.md has the
+catalog):
+
+  JIT001  tracer purity — no env reads, clocks, printing, telemetry, or
+          nonlocal/global mutation inside code that jax.jit traces
+  SYNC001 host-sync discipline — no .item()/np.asarray/block_until_ready
+          in the fit batch loop, executor forward/backward, or TrainStep
+          unless behind a telemetry/diagnostics gate
+  ENV001  env-var contract — every MXNET_* read goes through
+          base.get_env and code <-> docs/env_var.md stay in sync
+  NOOP001 import hygiene — no thread/socket/file creation at module
+          import without an env guard (the strict-no-op contract)
+  THR001  lock discipline — state written by a Thread target must be
+          accessed under a Lock elsewhere (or explicitly suppressed)
+
+Pure stdlib, AST-based.  Run ``python -m tools.mxlint --check`` from the
+repo root; suppress a finding inline with ``# mxlint: disable=RULE
+reason`` or accept legacy debt in tools/mxlint/baseline.json.
+"""
+from .core import (Finding, Project, lint, load_baseline, DEFAULT_TARGETS,
+                   ALL_RULES)
+
+__all__ = ["Finding", "Project", "lint", "load_baseline", "DEFAULT_TARGETS",
+           "ALL_RULES"]

@@ -1,0 +1,100 @@
+"""CLI: ``python -m tools.mxlint [targets...] [--json] [--check]``.
+
+Run from the repo root (or pass --root).  Exit status: 0 = clean or
+findings merely listed; with --check, 1 = at least one non-baselined
+finding; 2 = unparsable source files.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (ALL_RULES, DEFAULT_TARGETS, json_safe, lint,
+                   load_baseline, split_baselined, write_baseline)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.mxlint",
+        description="Repo-native semantic lint (docs/static_analysis.md).")
+    ap.add_argument("targets", nargs="*", default=list(DEFAULT_TARGETS),
+                    help="files/dirs relative to --root "
+                         "(default: %s)" % " ".join(DEFAULT_TARGETS))
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto from this file)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of %s" % ",".join(ALL_RULES))
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output (stable ordering; "
+                         "non-finite floats stringified)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on any non-baselined finding")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline path (default: tools/mxlint/"
+                         "baseline.json under --root)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show all findings as new)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept every current finding into the baseline")
+    ap.add_argument("--doc", default="docs/env_var.md",
+                    help="env-var contract doc, relative to --root")
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root) if args.root else \
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        bad = [r for r in rules if r not in ALL_RULES]
+        if bad:
+            ap.error("unknown rule(s): %s" % ",".join(bad))
+
+    findings, suppressed, errors = lint(
+        root, targets=tuple(args.targets), rules=rules, doc_path=args.doc)
+
+    bl_path = args.baseline or os.path.join(root, "tools", "mxlint",
+                                            "baseline.json")
+    baseline = set() if args.no_baseline else load_baseline(bl_path)
+    new, accepted = split_baselined(findings, baseline)
+
+    if args.write_baseline:
+        write_baseline(bl_path, findings)
+        print("wrote %d finding(s) to %s" % (len(findings), bl_path))
+        return 0
+
+    if args.as_json:
+        doc = {"version": 1, "root": root,
+               "counts": _counts(new),
+               "findings": [f.to_dict() for f in new],
+               "baselined": len(accepted),
+               "suppressed": len(suppressed),
+               "errors": [{"path": p, "message": m} for p, m in errors]}
+        print(json.dumps(json_safe(doc), indent=1, sort_keys=True))
+    else:
+        for f in new:
+            print("%s:%d: %s [%s] %s" % (f.rel, f.line, f.rule, f.context,
+                                         f.message))
+        for p, m in errors:
+            print("%s: PARSE ERROR %s" % (p, m))
+        print("mxlint: %d finding(s) (%d baselined, %d suppressed)"
+              % (len(new), len(accepted), len(suppressed)))
+
+    if errors:
+        return 2
+    if args.check and new:
+        return 1
+    return 0
+
+
+def _counts(findings):
+    out = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return out
+
+
+if __name__ == "__main__":
+    sys.exit(main())

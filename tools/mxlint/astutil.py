@@ -1,0 +1,223 @@
+"""Shared AST plumbing for the mxlint rules: one parse per file, alias
+resolution for dotted call names, parent links, qualified names, and the
+env-guard predicates several rules share."""
+from __future__ import annotations
+
+import ast
+import re
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*mxlint:\s*disable=([A-Za-z0-9_,]+)\s*(.*)$")
+
+
+class FileInfo(object):
+    """One parsed source file plus the derived tables the rules consume."""
+
+    def __init__(self, path, rel, src):
+        self.path = path          # absolute
+        self.rel = rel            # repo-relative, posix separators
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=rel)
+        self.parents = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.aliases = self._collect_aliases()
+        self.suppressions = self._collect_suppressions()
+        self.qualnames = self._collect_qualnames()
+
+    # ------------------------------------------------------------ aliases
+    def _collect_aliases(self):
+        """name -> dotted origin, for imports at any scope (over-approximate:
+        function-local imports land in the same flat table)."""
+        table = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    table[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = (node.module or "").lstrip(".")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    dotted = (mod + "." + a.name) if mod else a.name
+                    table[a.asname or a.name] = dotted
+        return table
+
+    def dotted(self, node):
+        """Dotted name of an expression ('jax.jit', 'os.environ.get',
+        'self._run'), with the head resolved through the import table.
+        Returns '' for non-name expressions."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            head = node.id
+            parts.append(self.aliases.get(head, head))
+        elif isinstance(node, ast.Call):
+            inner = self.dotted(node.func)
+            if not inner:
+                return ""
+            parts.append(inner + "()")
+        else:
+            return ""
+        return ".".join(reversed(parts))
+
+    # ------------------------------------------------------- suppressions
+    def _collect_suppressions(self):
+        """line (1-based) -> {rule: reason}.  A comment-only disable line
+        also covers the next line (the statement it annotates)."""
+        out = {}
+        for i, text in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = m.group(2).strip()
+            entry = {r: reason for r in rules}
+            out.setdefault(i, {}).update(entry)
+            if text.lstrip().startswith("#"):      # standalone comment line
+                out.setdefault(i + 1, {}).update(entry)
+        return out
+
+    def suppressed(self, rule, line):
+        return rule in self.suppressions.get(line, {})
+
+    # ---------------------------------------------------------- qualnames
+    def _collect_qualnames(self):
+        """node -> qualname for every function/class def."""
+        out = {}
+
+        def visit(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    q = (prefix + "." + child.name) if prefix else child.name
+                    out[child] = q
+                    visit(child, q)
+                else:
+                    visit(child, prefix)
+
+        visit(self.tree, "")
+        return out
+
+    def context_of(self, node):
+        """Qualname of the innermost enclosing def, or '<module>'."""
+        cur = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return self.qualnames.get(cur, cur.name)
+            cur = self.parents.get(cur)
+        return "<module>"
+
+    def ancestors(self, node):
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    # ------------------------------------------------------------- scans
+    def functions(self):
+        """{qualname: def-node} for every function in the file."""
+        return {q: n for n, q in self.qualnames.items()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def classes(self):
+        return {q: n for n, q in self.qualnames.items()
+                if isinstance(n, ast.ClassDef)}
+
+
+# ------------------------------------------------------------ env predicates
+def is_env_read(fi, node):
+    """Call or subscript that reads the process environment."""
+    if isinstance(node, ast.Call):
+        d = fi.dotted(node.func)
+        return (d.endswith("get_env") or d in ("os.getenv",)
+                or d.startswith("os.environ."))
+    if isinstance(node, ast.Subscript):
+        return fi.dotted(node.value) == "os.environ"
+    return False
+
+
+def env_read_var(fi, node):
+    """The MXNET_* literal a read targets, or None."""
+    args = ()
+    if isinstance(node, ast.Call):
+        args = node.args
+    elif isinstance(node, ast.Subscript):
+        sl = node.slice
+        args = (sl,)
+    for a in args[:1]:
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a.value
+    return None
+
+
+def mentions_env(fi, node):
+    """Does this expression consult the environment (directly or via a
+    string naming an MXNET_* var)?"""
+    for n in ast.walk(node):
+        if is_env_read(fi, n):
+            return True
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and n.value.startswith(("MXNET_", "MXTPU_")):
+            return True
+    return False
+
+
+def body_reads_env(fi, func_node):
+    return any(is_env_read(fi, n) for n in ast.walk(func_node))
+
+
+def under_env_guard(fi, node, extra_names=()):
+    """True when an ancestor ``if`` tests the environment (or one of the
+    named gate identifiers) — the shape every opt-in path here uses."""
+    extra = set(extra_names)
+    for anc in fi.ancestors(node):
+        if isinstance(anc, ast.If) and node is not anc.test:
+            if mentions_env(fi, anc.test):
+                return True
+            for n in ast.walk(anc.test):
+                if isinstance(n, ast.Name) and n.id in extra:
+                    return True
+                if isinstance(n, ast.Attribute) and n.attr in extra:
+                    return True
+    return False
+
+
+def trace_env_vars(fi):
+    """{var: line} for MXNET_* vars registered in this file's
+    ``TRACE_ENV_DEFAULTS`` table (base.py) — the contract for env flags
+    that are legitimately consulted at trace time because every executor
+    jit keys its cache on ``base.trace_env_key()``."""
+    out = {}
+    for n in ast.walk(fi.tree):
+        if isinstance(n, ast.Assign) \
+                and any(isinstance(t, ast.Name)
+                        and t.id == "TRACE_ENV_DEFAULTS"
+                        for t in n.targets) \
+                and isinstance(n.value, (ast.Tuple, ast.List)):
+            for v in n.value.elts:
+                if isinstance(v, ast.Tuple) and v.elts \
+                        and isinstance(v.elts[0], ast.Constant):
+                    out.setdefault(v.elts[0].value, v.lineno)
+    return out
+
+
+def call_targets(fi, func_node, cls_prefix=None):
+    """Names this function calls, resolved to same-file qualnames where
+    possible: bare ``f()`` -> 'f' (module scope), ``self.m()`` ->
+    '<Class>.m' when cls_prefix is given."""
+    out = set()
+    for n in ast.walk(func_node):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if isinstance(f, ast.Name):
+            out.add(f.id)
+        elif isinstance(f, ast.Attribute) and cls_prefix \
+                and isinstance(f.value, ast.Name) and f.value.id == "self":
+            out.add(cls_prefix + "." + f.attr)
+    return out

@@ -1,0 +1,153 @@
+"""mxlint runner: file discovery, finding/baseline model, rule dispatch.
+
+Findings are keyed WITHOUT line numbers (rule|path|context|message) so the
+committed baseline survives unrelated edits to the same file; the line is
+carried for display only.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from .astutil import FileInfo
+
+DEFAULT_TARGETS = ("mxnet_tpu", "tools", "bench.py")
+EXCLUDE_DIRS = {"__pycache__", "fixtures"}
+
+
+class Finding(object):
+    def __init__(self, rule, rel, line, context, message):
+        self.rule = rule
+        self.rel = rel
+        self.line = int(line)
+        self.context = context
+        self.message = message
+
+    def key(self):
+        return "|".join((self.rule, self.rel, self.context, self.message))
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.rel, "line": self.line,
+                "context": self.context, "message": self.message,
+                "key": self.key()}
+
+    def __repr__(self):
+        return "%s %s:%d [%s] %s" % (self.rule, self.rel, self.line,
+                                     self.context, self.message)
+
+
+class Project(object):
+    """The analyzed file set plus repo-level context the rules need."""
+
+    def __init__(self, root, targets=DEFAULT_TARGETS,
+                 doc_path="docs/env_var.md"):
+        self.root = os.path.abspath(root)
+        self.doc_path = os.path.join(self.root, doc_path)
+        self.files = []
+        self.errors = []          # unparsable files: (rel, message)
+        for rel in _discover(self.root, targets):
+            path = os.path.join(self.root, rel)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    src = f.read()
+                self.files.append(FileInfo(path, rel, src))
+            except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                self.errors.append((rel, "%s: %s" % (type(e).__name__, e)))
+
+    def file(self, rel):
+        for fi in self.files:
+            if fi.rel == rel:
+                return fi
+        return None
+
+
+def _discover(root, targets):
+    rels = []
+    for t in targets:
+        full = os.path.join(root, t)
+        if os.path.isfile(full):
+            if t.endswith(".py"):
+                rels.append(t.replace(os.sep, "/"))
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in EXCLUDE_DIRS)
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                rels.append(rel.replace(os.sep, "/"))
+    return sorted(set(rels))
+
+
+def all_rules():
+    from . import rule_jit, rule_sync, rule_env, rule_noop, rule_thread
+    return {m.RULE: m for m in (rule_jit, rule_sync, rule_env, rule_noop,
+                                rule_thread)}
+
+
+ALL_RULES = ("JIT001", "SYNC001", "ENV001", "NOOP001", "THR001")
+
+
+def lint(root, targets=DEFAULT_TARGETS, rules=None,
+         doc_path="docs/env_var.md"):
+    """Run the rule families; returns (findings, suppressed, errors).
+    ``findings`` excludes inline-suppressed ones (those are returned
+    separately so tooling can count them)."""
+    project = Project(root, targets=targets, doc_path=doc_path)
+    table = all_rules()
+    findings, suppressed = [], []
+    for rid in (rules or ALL_RULES):
+        mod = table[rid]
+        for f in mod.run(project):
+            fi = project.file(f.rel)
+            if fi is not None and fi.suppressed(f.rule, f.line):
+                suppressed.append(f)
+            else:
+                findings.append(f)
+    findings.sort(key=lambda f: (f.rel, f.line, f.rule, f.message))
+    suppressed.sort(key=lambda f: (f.rel, f.line, f.rule, f.message))
+    return findings, suppressed, project.errors
+
+
+# ---------------------------------------------------------------- baseline
+def load_baseline(path):
+    """Accepted-legacy finding keys.  Missing file = empty baseline."""
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return set(data.get("findings", []))
+
+
+def write_baseline(path, findings):
+    data = {"version": 1,
+            "comment": "Accepted legacy mxlint findings. Regenerate with "
+                       "`python -m tools.mxlint --write-baseline`; shrink "
+                       "it whenever you fix one for real.",
+            "findings": sorted({f.key() for f in findings})}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def split_baselined(findings, baseline_keys):
+    new, accepted = [], []
+    for f in findings:
+        (accepted if f.key() in baseline_keys else new).append(f)
+    return new, accepted
+
+
+# ------------------------------------------------------------- json output
+def json_safe(obj):
+    """PR-5 convention: JSON output must be RFC-8259 parseable everywhere,
+    so non-finite floats are stringified rather than emitted as bare
+    NaN/Infinity tokens."""
+    if isinstance(obj, float):
+        return obj if obj == obj and obj not in (float("inf"),
+                                                 float("-inf")) else str(obj)
+    if isinstance(obj, dict):
+        return {k: json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    return obj

@@ -1,0 +1,143 @@
+"""NOOP001 — import-time work hygiene.
+
+``import mxnet_tpu`` with no MXNET_* env set must be a strict no-op: no
+threads, no sockets, no files (the contract telemetry.py /
+metrics_server.py / diagnostics.py keep by hand — autostart helpers that
+check their env var and return).  This rule flags resource creation
+reachable at module import that is NOT env-gated:
+
+  * threading.Thread / Timer, concurrent futures executors
+  * socket creation, HTTP servers
+  * subprocess spawns
+  * file creation (open for write/append, os.makedirs/mkdir, tempfile)
+
+A call is considered gated when it sits under an ``if`` that consults the
+environment, or inside a function whose body reads the environment (the
+early-return autostart pattern).  Reachability follows module-level
+statements into same-file functions a few calls deep.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import astutil
+from .core import Finding
+
+RULE = "NOOP001"
+_DEPTH = 3
+
+_HAZARD_DOTTED = {
+    "threading.Thread": "thread", "threading.Timer": "thread",
+    "concurrent.futures.ThreadPoolExecutor": "thread",
+    "ThreadPoolExecutor": "thread", "ProcessPoolExecutor": "process",
+    "socket.socket": "socket", "socket.create_connection": "socket",
+    "socket.create_server": "socket",
+    "http.server.HTTPServer": "socket", "HTTPServer": "socket",
+    "ThreadingHTTPServer": "socket",
+    "subprocess.Popen": "process", "subprocess.run": "process",
+    "subprocess.check_output": "process", "subprocess.check_call": "process",
+    "os.makedirs": "file", "os.mkdir": "file",
+    "tempfile.mkdtemp": "file", "tempfile.mkstemp": "file",
+    "tempfile.NamedTemporaryFile": "file", "tempfile.TemporaryFile": "file",
+}
+_WRITE_MODES = ("w", "a", "x")
+
+
+def _hazard(fi, n):
+    """(kind, label) when this call creates a thread/socket/process/file."""
+    if not isinstance(n, ast.Call):
+        return None
+    d = fi.dotted(n.func)
+    kind = _HAZARD_DOTTED.get(d)
+    if kind is None and d:
+        tail = d.rsplit(".", 1)[-1]
+        kind = _HAZARD_DOTTED.get(tail)
+    if kind:
+        return kind, d
+    if d == "open" or d.endswith(".open"):
+        mode = None
+        if len(n.args) >= 2 and isinstance(n.args[1], ast.Constant):
+            mode = n.args[1].value
+        for kw in n.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        if isinstance(mode, str) and any(c in mode for c in _WRITE_MODES):
+            return "file", "%s(mode=%r)" % (d, mode)
+    return None
+
+
+def _module_level_calls(fi):
+    """(call-node, directly_guarded) for statements executed at import —
+    skipping def/class bodies and the `if __name__ == "__main__"` block."""
+    out = []
+
+    def visit(stmts, guarded):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, ast.If):
+                test_src = ast.dump(st.test)
+                if "__name__" in test_src:
+                    continue
+                g = guarded or astutil.mentions_env(fi, st.test)
+                visit(st.body, g)
+                visit(st.orelse, g)
+                continue
+            if isinstance(st, (ast.Try, ast.With)):
+                visit(getattr(st, "body", []), guarded)
+                for h in getattr(st, "handlers", []):
+                    visit(h.body, guarded)
+                visit(getattr(st, "finalbody", []), guarded)
+                visit(getattr(st, "orelse", []), guarded)
+                continue
+            for n in ast.walk(st):
+                if isinstance(n, ast.Call):
+                    out.append((n, guarded))
+    visit(fi.tree.body, False)
+    return out
+
+
+def _check_fn(fi, fn_node, chain, findings, seen, depth):
+    """Walk a function reachable at import; its own env read gates it."""
+    if astutil.body_reads_env(fi, fn_node):
+        return
+    funcs = fi.functions()
+    nested = {n for sub in ast.walk(fn_node)
+              if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+              and sub is not fn_node for n in ast.walk(sub)}
+    for n in ast.walk(fn_node):
+        if n in nested or not isinstance(n, ast.Call):
+            continue
+        hz = _hazard(fi, n)
+        if hz and not astutil.under_env_guard(fi, n):
+            findings.append(Finding(
+                RULE, fi.rel, n.lineno, fi.context_of(n),
+                "%s creation (%s) reachable at import via %s without an "
+                "env guard — gate it behind an MXNET_* opt-in"
+                % (hz[0], hz[1], " -> ".join(chain))))
+        elif depth < _DEPTH and isinstance(n.func, ast.Name) \
+                and n.func.id in funcs and n.func.id not in seen:
+            seen.add(n.func.id)
+            _check_fn(fi, funcs[n.func.id], chain + [n.func.id],
+                      findings, seen, depth + 1)
+
+
+def run(project):
+    findings = []
+    for fi in project.files:
+        funcs = fi.functions()
+        for call, guarded in _module_level_calls(fi):
+            if guarded:
+                continue
+            hz = _hazard(fi, call)
+            if hz:
+                findings.append(Finding(
+                    RULE, fi.rel, call.lineno, "<module>",
+                    "%s creation (%s) at module import without an env "
+                    "guard — gate it behind an MXNET_* opt-in"
+                    % (hz[0], hz[1])))
+            elif isinstance(call.func, ast.Name) and call.func.id in funcs:
+                _check_fn(fi, funcs[call.func.id], [call.func.id],
+                          findings, {call.func.id}, 1)
+    return findings

@@ -1,0 +1,88 @@
+"""SYNC001 — host-sync discipline in hot paths.
+
+The per-step contract since PR 2: the training hot path pays nothing for
+observability, and device->host syncs are deliberate, not incidental.
+Inside the configured hot-path functions this rule flags calls that force
+a device sync —
+
+    .item()            float(x) / int(x) on non-literals
+    np.asarray/array   block_until_ready      jax.device_get
+
+— unless the call sits under an env/telemetry/diagnostics gate (an
+``if`` whose test consults the environment or one of the known gate
+flags), where a bounded sync is the documented cost of opting in.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import astutil
+from .core import Finding
+
+RULE = "SYNC001"
+
+# qualnames of the hot-path bodies, per repo-relative file
+HOT_PATHS = {
+    "mxnet_tpu/module/base_module.py": ("BaseModule._fit_impl",
+                                        "BaseModule.forward_backward"),
+    "mxnet_tpu/module/module.py": ("Module.forward", "Module.backward",
+                                   "Module.update"),
+    "mxnet_tpu/module/executor_group.py": (
+        "DataParallelExecutorGroup.forward",
+        "DataParallelExecutorGroup.backward"),
+    "mxnet_tpu/executor.py": ("Executor.forward", "Executor.backward"),
+    "mxnet_tpu/train.py": ("TrainStep.__call__", "EvalStep.__call__"),
+}
+
+# identifiers that mark an opt-in observability/diagnostics branch
+GATE_NAMES = ("_enabled", "enabled", "telemetry", "_tel", "diagnostics",
+              "_diag", "check_numerics", "_numerics", "scalar_due",
+              "_sampled", "sampled", "monitor", "_monitor", "profiling",
+              "is_running", "collect", "opt_stats", "naive", "is_naive",
+              "_check", "block", "_telemetry")
+
+
+def _is_sync_call(fi, n):
+    if not isinstance(n, ast.Call):
+        return None
+    f = n.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "item" and not n.args:
+            return ".item()"
+        if f.attr == "block_until_ready":
+            return "block_until_ready"
+    d = fi.dotted(f)
+    if d in ("jax.device_get", "jax.block_until_ready"):
+        return d
+    if d in ("numpy.asarray", "numpy.array"):
+        return d.replace("numpy", "np")
+    if d in ("float", "int") and n.args:
+        a = n.args[0]
+        if not isinstance(a, ast.Constant):
+            return "%s()" % d
+    return None
+
+
+def run(project):
+    findings = []
+    for fi in project.files:
+        wanted = HOT_PATHS.get(fi.rel)
+        if not wanted:
+            continue
+        funcs = fi.functions()
+        for q in wanted:
+            node = funcs.get(q)
+            if node is None:
+                continue
+            for n in ast.walk(node):
+                what = _is_sync_call(fi, n)
+                if what is None:
+                    continue
+                if astutil.under_env_guard(fi, n, extra_names=GATE_NAMES):
+                    continue
+                findings.append(Finding(
+                    RULE, fi.rel, n.lineno, q,
+                    "host sync (%s) in hot path %s — move it behind a "
+                    "telemetry/diagnostics gate or out of the per-step "
+                    "body" % (what, q)))
+    return findings

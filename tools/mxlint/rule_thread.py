@@ -1,0 +1,256 @@
+"""THR001 — lock discipline for threaded state.
+
+For every class that launches a thread (``threading.Thread(target=
+self._x)`` or a ``run`` method on a Thread subclass), an attribute the
+thread body WRITES is shared mutable state: other methods touching it
+must do so under a held Lock (``with self._lock:``) — or the write site
+carries an explicit suppression naming the publication protocol (e.g.
+the immutable-snapshot pattern diagnostics.py uses).
+
+``__init__`` accesses are construction-time (before the thread exists)
+and don't count; neither do accesses in other thread bodies of the same
+class (both sides racing is still a finding at the write).
+
+The same discipline applies at module scope (the watchdog/metrics-server
+shape): a module-level function passed as ``Thread(target=...)`` that
+assigns a ``global`` is publishing shared state; other top-level
+functions touching that global must hold a module Lock (``with _lock:``)
+or the write carries a suppression naming the protocol.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import astutil
+from .core import Finding
+
+RULE = "THR001"
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "threading.Semaphore", "threading.BoundedSemaphore"}
+
+
+def _self_attr(node):
+    """'x' when node is ``self.x``."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _methods(fi, cls_node, cls_q):
+    out = {}
+    for st in cls_node.body:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[st.name] = st
+    return out
+
+
+def _lock_attrs(fi, methods):
+    locks = set()
+    for m in methods.values():
+        for n in ast.walk(m):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                if fi.dotted(n.value.func) in _LOCK_CTORS:
+                    for t in n.targets:
+                        a = _self_attr(t)
+                        if a:
+                            locks.add(a)
+    return locks
+
+
+def _thread_bodies(fi, cls_node, methods):
+    """Method names that run on a spawned thread."""
+    bodies = set()
+    for base in cls_node.bases:
+        if fi.dotted(base) in ("threading.Thread", "Thread") \
+                and "run" in methods:
+            bodies.add("run")
+    for m in methods.values():
+        for n in ast.walk(m):
+            if isinstance(n, ast.Call) \
+                    and fi.dotted(n.func) in ("threading.Thread",
+                                              "threading.Timer", "Thread"):
+                for kw in n.keywords:
+                    if kw.arg in ("target", "function"):
+                        a = _self_attr(kw.value)
+                        if a and a in methods:
+                            bodies.add(a)
+    return bodies
+
+
+def _under_lock(fi, node, locks):
+    """Inside ``with self.<lock>:`` for a known (or lock-named) attr."""
+    for anc in fi.ancestors(node):
+        if not isinstance(anc, ast.With):
+            continue
+        for item in anc.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func
+            a = _self_attr(expr)
+            if a and (a in locks or "lock" in a.lower()
+                      or "cond" in a.lower()):
+                return True
+    return False
+
+
+def _written_attrs(fi, body_node, locks):
+    """{attr: (line, locked)} written in the thread body (plain and
+    augmented assigns to self.<attr>)."""
+    out = {}
+    for n in ast.walk(body_node):
+        targets = []
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = [n.target]
+        for t in targets:
+            a = _self_attr(t)
+            if a and a not in locks and a not in out:
+                out[a] = (t.lineno, _under_lock(fi, t, locks))
+    return out
+
+
+# ------------------------------------------------------------ module scope
+def _module_lock_names(fi):
+    locks = set()
+    for st in fi.tree.body:
+        if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call) \
+                and fi.dotted(st.value.func) in _LOCK_CTORS:
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    locks.add(t.id)
+    return locks
+
+
+def _module_thread_targets(fi):
+    """Top-level function names passed as Thread/Timer target= anywhere in
+    the file (local closures manage their state via closure objects and
+    are out of scope)."""
+    top = {st.name for st in fi.tree.body
+           if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    out = set()
+    for n in ast.walk(fi.tree):
+        if isinstance(n, ast.Call) \
+                and fi.dotted(n.func) in ("threading.Thread",
+                                          "threading.Timer", "Thread"):
+            for kw in n.keywords:
+                if kw.arg in ("target", "function") \
+                        and isinstance(kw.value, ast.Name) \
+                        and kw.value.id in top:
+                    out.add(kw.value.id)
+    return out
+
+
+def _under_mod_lock(fi, node, locks):
+    for anc in fi.ancestors(node):
+        if not isinstance(anc, ast.With):
+            continue
+        for item in anc.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func
+            if isinstance(expr, ast.Name) \
+                    and (expr.id in locks or "lock" in expr.id.lower()
+                         or "cond" in expr.id.lower()):
+                return True
+    return False
+
+
+def _global_writes(fi, fn_node):
+    """{name: line} for globals this function declares AND assigns."""
+    declared = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Global):
+            declared.update(n.names)
+    out = {}
+    for n in ast.walk(fn_node):
+        targets = []
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = [n.target]
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id in declared \
+                    and t.id not in out:
+                out[t.id] = t.lineno
+    return out
+
+
+def _module_findings(fi, findings):
+    bodies = _module_thread_targets(fi)
+    if not bodies:
+        return
+    locks = _module_lock_names(fi)
+    top_funcs = {st.name: st for st in fi.tree.body
+                 if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for b in sorted(bodies):
+        writes = _global_writes(fi, top_funcs[b])
+        for name, wline in sorted(writes.items()):
+            if name in locks:
+                continue
+            wlocked = _under_mod_lock(
+                fi, _find_write_node(top_funcs[b], name, wline), locks)
+            race = None
+            for fname, fn in sorted(top_funcs.items()):
+                if fname == b or fname in bodies:
+                    continue
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.Name) and n.id == name \
+                            and not _under_mod_lock(fi, n, locks):
+                        race = (fname, n.lineno)
+                        break
+                if race:
+                    break
+            if race and not wlocked:
+                findings.append(Finding(
+                    RULE, fi.rel, wline, b,
+                    "global '%s' written on the %s thread is accessed "
+                    "lock-free in %s (line %d) — hold a Lock on both "
+                    "sides or document the publication protocol with a "
+                    "suppression" % (name, b, race[0], race[1])))
+
+
+def _find_write_node(fn_node, name, line):
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Name) and n.id == name and n.lineno == line:
+            return n
+    return fn_node
+
+
+def run(project):
+    findings = []
+    for fi in project.files:
+        _module_findings(fi, findings)
+        for cls_q, cls_node in sorted(fi.classes().items()):
+            methods = _methods(fi, cls_node, cls_q)
+            bodies = _thread_bodies(fi, cls_node, methods)
+            if not bodies:
+                continue
+            locks = _lock_attrs(fi, methods)
+            body_nodes = {methods[b] for b in bodies}
+            for b in sorted(bodies):
+                for attr, (wline, wlocked) in sorted(
+                        _written_attrs(fi, methods[b], locks).items()):
+                    # find an unlocked access from a non-thread method
+                    race = None
+                    for name, m in sorted(methods.items()):
+                        if m in body_nodes or name == "__init__":
+                            continue
+                        for n in ast.walk(m):
+                            if _self_attr(n) == attr \
+                                    and not _under_lock(fi, n, locks):
+                                race = (cls_q + "." + name, n.lineno)
+                                break
+                        if race:
+                            break
+                    if race and not wlocked:
+                        findings.append(Finding(
+                            RULE, fi.rel, wline, cls_q + "." + b,
+                            "attribute '%s' written on the %s thread is "
+                            "accessed lock-free in %s (line %d) — hold a "
+                            "Lock on both sides or document the "
+                            "publication protocol with a suppression"
+                            % (attr, cls_q + "." + b, race[0], race[1])))
+    return findings
