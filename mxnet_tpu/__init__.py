@@ -26,6 +26,7 @@ from . import initializer
 from . import initializer as init
 from . import optimizer
 from . import optimizer as opt
+from . import amp
 from . import metric
 from . import lr_scheduler
 from . import callback

@@ -1,0 +1,192 @@
+"""Mixed-precision training policy (AMP).
+
+The reference era trained fp32 end-to-end; on TPU the MXU runs bfloat16 at
+2x the fp32 rate and half the HBM traffic, so the fused ``TrainStep``
+(mxnet_tpu/train.py) accepts a :class:`Policy`:
+
+* **compute dtype** — the lowered graph (activations, conv/matmul inputs)
+  runs in ``bfloat16`` (or ``float16``); labels keep their dtype (class
+  ids round in half precision);
+* **master weights** — parameters and optimizer state stay ``float32``;
+  each step casts a bf16 *copy* of the weights into the forward, and the
+  update applies f32 gradients to the f32 masters;
+* **dynamic loss scaling** — the loss is scaled by ``S`` before backward
+  (implemented as scaling the cotangent seeds — the graph is linear in
+  them) and the gradients are unscaled by ``1/S`` before the optimizer
+  (the optimizer's own ``rescale_grad`` still applies — each factor is
+  applied exactly once).  Non-finite scaled gradients are detected
+  ON-DEVICE and the whole update is skipped in a ``lax.cond`` (weights,
+  optimizer state, aux moving stats all unchanged) while ``S`` halves;
+  after ``growth_interval`` consecutive good steps ``S`` doubles.  The
+  scale/good-step/overflow counters live INSIDE the donated jit as carried
+  state, so the hot path stays sync-free — they only cross to the host
+  when telemetry asks (``loss_scale`` gauge, ``amp_overflow_steps``
+  counter, ``train_loss_scale`` curve).
+
+Resolution is strictly dispatch-time: ``resolve_policy`` reads
+``MXNET_AMP`` / ``MXNET_LOSS_SCALE`` when a TrainStep (or ``Module.fit``'s
+fused driver) is CONSTRUCTED, never under trace (mxlint JIT001), and the
+fused-fit TrainStep cache keys on ``Policy.key()`` so toggling the env
+lever between ``fit()`` calls recompiles instead of silently reusing the
+stale program.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError, get_env
+
+__all__ = ["Policy", "resolve_policy"]
+
+# bfloat16 shares float32's exponent range, so scaling exists mainly to
+# keep tiny gradients out of the flush-to-zero band; float16's 5-bit
+# exponent is why the classic 2**15 default exists at all.
+_DEFAULT_SCALE = 2.0 ** 15
+_DEFAULT_GROWTH_INTERVAL = 2000
+_MAX_SCALE = 2.0 ** 24
+_MIN_SCALE = 2.0 ** -14
+
+_COMPUTE_DTYPES = ("bfloat16", "float16", "float32")
+_DTYPE_ALIASES = {"bf16": "bfloat16", "fp16": "float16", "half": "float16",
+                  "fp32": "float32", "f32": "float32"}
+
+
+class Policy(object):
+    """Precision policy for the fused train/eval steps.
+
+    Parameters
+    ----------
+    compute_dtype : 'bfloat16' (default) | 'float16' | 'float32'
+        dtype the lowered graph computes in.  'float32' keeps today's
+        numerics while still exercising the loss-scale machinery (the
+        test isolation mode).
+    loss_scale : float, optional
+        initial loss scale ``S`` (default 2**15).  Powers of two cost no
+        precision: scaling and unscaling by an exact power of two are
+        exact float operations.
+    dynamic : bool
+        True (default): halve on overflow, double after
+        ``growth_interval`` consecutive finite steps.  False: ``S`` is
+        static (overflow steps are still skipped and counted).
+    """
+
+    def __init__(self, compute_dtype="bfloat16", loss_scale=None,
+                 dynamic=True, growth_interval=_DEFAULT_GROWTH_INTERVAL,
+                 growth_factor=2.0, backoff_factor=0.5,
+                 max_scale=_MAX_SCALE, min_scale=_MIN_SCALE):
+        compute_dtype = _DTYPE_ALIASES.get(str(compute_dtype),
+                                           str(compute_dtype))
+        if compute_dtype not in _COMPUTE_DTYPES:
+            raise MXNetError("Policy: compute_dtype must be one of %s, got "
+                             "%r" % (_COMPUTE_DTYPES, compute_dtype))
+        self.compute_dtype = compute_dtype
+        self.loss_scale = float(_DEFAULT_SCALE if loss_scale is None
+                                else loss_scale)
+        if not (self.loss_scale > 0):
+            raise MXNetError("Policy: loss_scale must be > 0, got %r"
+                             % loss_scale)
+        self.dynamic = bool(dynamic)
+        self.growth_interval = int(growth_interval)
+        if self.dynamic and self.growth_interval < 1:
+            raise MXNetError("Policy: growth_interval must be >= 1")
+        self.growth_factor = float(growth_factor)
+        self.backoff_factor = float(backoff_factor)
+        self.max_scale = float(max_scale)
+        self.min_scale = float(min_scale)
+
+    def key(self):
+        """Hashable identity for compiled-step caches (the fused-fit
+        TrainStep cache keys on this, so an env toggle between fits
+        recompiles instead of reusing the stale program)."""
+        return (self.compute_dtype, self.loss_scale, self.dynamic,
+                self.growth_interval, self.growth_factor,
+                self.backoff_factor, self.max_scale, self.min_scale)
+
+    def describe(self):
+        """Short human/json form for logs and BENCH meta."""
+        return "%s/%s-scale-%g" % (self.compute_dtype,
+                                   "dyn" if self.dynamic else "static",
+                                   self.loss_scale)
+
+    # ------------------------------------------------------------- jit state
+    def init_state(self):
+        """Host-side initial loss-scale state pytree: the current scale,
+        the consecutive-good-step counter, and the cumulative overflow
+        (skipped-update) count.  Lives donated inside the step jit."""
+        return {"scale": _np.float32(self.loss_scale),
+                "good": _np.int32(0),
+                "overflow": _np.int32(0)}
+
+    def next_state(self, state, finite):
+        """Traced transition of the loss-scale state given this step's
+        on-device ``finite`` verdict.  Pure jnp math — safe inside jit
+        (and inside the ``lax.scan`` chunk body)."""
+        import jax.numpy as jnp
+        scale, good = state["scale"], state["good"]
+        overflow = state["overflow"] + jnp.where(finite, 0, 1).astype(
+            state["overflow"].dtype)
+        if not self.dynamic:
+            return {"scale": scale, "good": good, "overflow": overflow}
+        good2 = good + 1
+        grow = good2 >= self.growth_interval
+        grown = jnp.minimum(scale * self.growth_factor, self.max_scale)
+        new_scale = jnp.where(
+            finite,
+            jnp.where(grow, grown, scale),
+            jnp.maximum(scale * self.backoff_factor, self.min_scale))
+        new_good = jnp.where(finite, jnp.where(grow, 0, good2), 0)
+        return {"scale": new_scale.astype(scale.dtype),
+                "good": new_good.astype(good.dtype),
+                "overflow": overflow}
+
+
+def resolve_policy(policy=None, default=None):
+    """Dispatch-time policy resolution (never called under trace).
+
+    An explicit ``policy`` wins (``True`` means the default bf16 policy;
+    a dtype string builds one).  Otherwise ``MXNET_AMP`` selects:
+    ``0``/unset -> ``default`` (None for the library; bench.py passes its
+    own bf16 default), ``1``/``bfloat16`` -> bf16, ``float16`` -> fp16.
+    ``MXNET_LOSS_SCALE`` tunes the scaling: ``dynamic`` (default),
+    ``dynamic:<init>``, or a bare float for a static scale."""
+    if policy is not None:
+        if isinstance(policy, Policy):
+            return policy
+        if policy is True:
+            return Policy()
+        if isinstance(policy, str):
+            return Policy(compute_dtype=policy)
+        raise MXNetError("policy must be a Policy, True, or a dtype "
+                         "string; got %r" % (policy,))
+    amp = get_env("MXNET_AMP")
+    if amp is None:
+        return default          # unset: the caller's default stands
+    if amp in ("0", "", "false", "False"):
+        return None             # explicit off overrides any default
+    if amp in ("1", "true", "True", "bfloat16", "bf16"):
+        dtype = "bfloat16"
+    elif amp in ("float16", "fp16", "half"):
+        dtype = "float16"
+    else:
+        raise MXNetError("MXNET_AMP=%r: expected 0/1/bfloat16/float16"
+                         % amp)
+    spec = get_env("MXNET_LOSS_SCALE", "dynamic")
+    dynamic, scale = True, None
+    if spec.startswith("dynamic"):
+        _, sep, init = spec.partition(":")
+        if sep:
+            scale = _parse_scale(init)
+    else:
+        dynamic, scale = False, _parse_scale(spec)
+    return Policy(compute_dtype=dtype, loss_scale=scale, dynamic=dynamic)
+
+
+def _parse_scale(text):
+    try:
+        val = float(text)
+    except ValueError:
+        raise MXNetError("MXNET_LOSS_SCALE=%r: expected dynamic, "
+                         "dynamic:<scale>, or a float" % text)
+    if not val > 0:
+        raise MXNetError("MXNET_LOSS_SCALE must be > 0, got %r" % text)
+    return val

@@ -47,6 +47,48 @@ def _node_uid(node, uid_map):
     return u
 
 
+def _make_scale_backward():
+    """Identity forward / cotangent-times-scale backward.
+
+    The loss heads (ops/loss.py) emit their FIXED reference gradient and
+    ignore the incoming cotangent (SoftmaxOutput's ``out - onehot``
+    semantics), so AMP loss scaling cannot ride the vjp seeds.  Instead
+    ``_Lowered.run(head_grad_scale=...)`` wraps each loss head's data
+    input in this op: everything BELOW the head — the whole backward
+    chain in compute dtype — sees its cotangents multiplied by the traced
+    scale, which is exactly "scale the loss before backward" (and the
+    TPU-native generalisation of the reference's ``out_grad`` head-grad
+    multiplier, softmax_output-inl.h)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.custom_vjp
+    def scale_backward(x, s):
+        return x
+
+    def scale_backward_fwd(x, s):
+        return x, s
+
+    def scale_backward_bwd(s, g):
+        return g * s.astype(g.dtype), jnp.zeros_like(s)
+
+    scale_backward.defvjp(scale_backward_fwd, scale_backward_bwd)
+    return scale_backward
+
+
+# one process-wide instance (built on first use so importing the module
+# does not import jax); dict memo, not a `global` rebind — this is reached
+# from traced code, which must stay declaration-free
+_SCALE_BACKWARD = {}
+
+
+def _get_scale_backward():
+    fn = _SCALE_BACKWARD.get("fn")
+    if fn is None:
+        fn = _SCALE_BACKWARD["fn"] = _make_scale_backward()
+    return fn
+
+
 class _Lowered(object):
     """The pure-functional form of a symbol graph."""
 
@@ -332,10 +374,14 @@ class _Lowered(object):
         return True
 
     def run(self, arg_vals, aux_vals, rng, is_train, collect=False,
-            no_grad_inputs=()):
+            no_grad_inputs=(), head_grad_scale=None):
         """Trace the graph: dict name->array in, (outputs, aux_updates) out.
         With collect=True also returns {internal_name: value} for every op
         output — the monitor's data, gathered from the ONE real execution.
+
+        ``head_grad_scale`` (a traced scalar; AMP loss scaling) wraps every
+        loss head's data input in the scale-backward identity so the whole
+        backward chain below the heads sees scaled cotangents.
 
         Layout pass (TPU-native; no reference analogue — the nnvm graph never
         needed one because cuDNN consumed NCHW directly): XLA:TPU inserts
@@ -450,6 +496,12 @@ class _Lowered(object):
                 else:
                     ins = [to_cf(v) if in_keys[j] in nhwc else v
                            for j, v in enumerate(ins)]
+            if head_grad_scale is not None and is_train \
+                    and getattr(op, "is_loss", False) and ins:
+                # AMP: scale the gradient the head emits (the heads ignore
+                # their incoming cotangent — reference loss semantics)
+                ins = [_get_scale_backward()(ins[0], head_grad_scale)] \
+                    + ins[1:]
             call = op.make_callable(params, is_train)
             if op.needs_rng:
                 sub = jax.random.fold_in(rng, _node_uid(node, self.uid))

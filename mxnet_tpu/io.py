@@ -23,7 +23,8 @@ from .ndarray import NDArray
 from . import telemetry as _tel
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "MNISTIter",
-           "CSVIter", "ResizeIter", "PrefetchingIter"]
+           "CSVIter", "ResizeIter", "PrefetchingIter", "DevicePrefetchIter",
+           "device_prefetch_depth"]
 
 
 def _count_batch(it):
@@ -554,6 +555,117 @@ class PrefetchingIter(DataIter):
     def __del__(self):
         try:
             self._drain()  # unblock producers stuck in q.put, release batches
+        except Exception:
+            pass
+
+
+def device_prefetch_depth():
+    """Device-prefetch staging depth from ``MXNET_DEVICE_PREFETCH``:
+    unset/``1`` -> 2 (double buffering, the default), ``0`` -> 0
+    (disabled), ``N >= 2`` -> depth N.  Read at dispatch time (when a fit
+    epoch or a bench staging loop starts), never under trace."""
+    from .base import get_env
+    raw = get_env("MXNET_DEVICE_PREFETCH", "1")
+    try:
+        n = int(raw)
+    except (TypeError, ValueError):
+        raise MXNetError("MXNET_DEVICE_PREFETCH=%r: expected 0 (off), 1 "
+                         "(double buffering) or a queue depth >= 2" % raw)
+    if n <= 0:
+        return 0
+    return max(2, n)
+
+
+class DevicePrefetchIter(object):
+    """Depth-2 (default) *device-side* staging pipeline.
+
+    ``PrefetchingIter`` overlaps host-side batch PRODUCTION with compute;
+    the host->HBM transfer itself still happens synchronously when the
+    step is dispatched.  This wrapper closes that gap — the TPU-native
+    replacement for the reference's pinned-memory ``dmlc::ThreadedIter``
+    (src/io/iter_prefetcher.h): a daemon producer thread pulls items from
+    ``source`` and calls ``stage`` on each, ISSUING the sharded
+    ``jax.device_put`` for batch N+1 while the consumer computes step N,
+    through a bounded queue of ``depth`` staged batches.
+
+    ``stage`` owns the placement (it receives whatever ``source`` yields
+    and its return value is what ``next()`` hands back): the fused fit
+    driver stages ``DataBatch`` dicts onto the TrainStep's device/sharding
+    (module/_FusedFit), bench.py stages host arrays with
+    ``TrainStep.shard_batch``.  Staging runs on the producer thread, so a
+    ``stage`` that blocks on the transfer still overlaps compute.
+
+    Exceptions in ``source``/``stage`` are forwarded to the consumer;
+    exhaustion is a queue sentinel (same discipline as PrefetchingIter).
+    One epoch per instance — wrap the epoch's iterator, drain falls out
+    at StopIteration or garbage collection.
+    """
+
+    _STOP = object()
+
+    class _Raised(object):
+        def __init__(self, exc):
+            self.exc = exc
+
+    def __init__(self, source, stage=None, depth=2):
+        import queue as _queue
+        self._source = iter(source)
+        self._stage = stage if stage is not None else (lambda b: b)
+        self._queue = _queue.Queue(maxsize=max(1, int(depth)))
+        self._alive = True
+        self._exhausted = False
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+
+    def _producer(self):
+        while True:
+            try:
+                item = self._stage(next(self._source))
+            except StopIteration:
+                self._queue.put(self._STOP)
+                return
+            except Exception as exc:   # forward, don't vanish
+                self._queue.put(self._Raised(exc))
+                return
+            self._queue.put(item)
+            if not self._alive:
+                return
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted:
+            raise StopIteration
+        item = self._queue.get()
+        if item is self._STOP:
+            self._exhausted = True
+            raise StopIteration
+        if isinstance(item, self._Raised):
+            self._exhausted = True
+            raise item.exc
+        if _tel._enabled:
+            _tel.counter("io_device_prefetch_batches")
+        return item
+
+    next = __next__
+
+    def drain(self):
+        """Stop the producer and empty the queue (idempotent)."""
+        self._alive = False
+        t = self._thread
+        if t is not None:
+            while t.is_alive():
+                try:
+                    self._queue.get(timeout=0.01)
+                except Exception:
+                    pass
+            t.join()
+        self._exhausted = True
+
+    def __del__(self):
+        try:
+            self.drain()   # unblock a producer stuck in queue.put
         except Exception:
             pass
 

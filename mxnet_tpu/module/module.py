@@ -397,7 +397,7 @@ class Module(BaseModule):
         self._exec_group.install_monitor(mon)
 
     # ------------------------------------------------- fused fit fast path
-    def _start_fused_fit(self):
+    def _start_fused_fit(self, policy=None):
         """Return a TrainStep-backed per-batch trainer, or None.
 
         The reference's ``Module.fit`` IS its benchmarked path
@@ -407,13 +407,24 @@ class Module(BaseModule):
         update rule, no monitor/states/fixed params — fit's inner loop runs
         on the fused SPMD TrainStep instead: forward + backward + optimizer
         update as ONE donated XLA program per batch (mxnet_tpu/train.py).
-        Disable with MXNET_FUSED_FIT=0."""
+        Disable with MXNET_FUSED_FIT=0.
+
+        ``policy`` (an amp.Policy, or None to consult MXNET_AMP here at
+        dispatch time) selects mixed-precision training: bf16 compute, f32
+        master weights, loss scaling carried inside the donated step."""
         import logging
         from ..base import get_env
+        from .. import amp as _amp
+        policy = _amp.resolve_policy(policy)
 
         def fallback(why):
             # the general path is ~3.4x slower per batch (docs/perf.md);
             # surfacing WHY keeps the cost visible (VERDICT r3 weak-item 5)
+            if policy is not None:
+                # AMP rides the fused step only — falling back silently
+                # would train f32 while the operator believes bf16
+                why += " (MXNET_AMP/policy ignored: the general path "\
+                       "trains f32)"
             logging.info("Module.fit: general (executor) path — %s", why)
             return None
 
@@ -445,7 +456,7 @@ class Module(BaseModule):
                 "dist" in getattr(self._kvstore, "type", ""):
             return fallback("dist kvstore")
         try:
-            return _FusedFit(self)
+            return _FusedFit(self, policy)
         except MXNetError as e:
             return fallback(str(e))
 
@@ -453,19 +464,31 @@ class Module(BaseModule):
 class _FusedFit(object):
     """Per-batch fused training engine behind Module.fit (see above)."""
 
-    def __init__(self, module):
+    def __init__(self, module, policy=None):
         import jax
         from ..train import TrainStep
         self._mod = module
-        # one XLA program per (optimizer config): cache the compiled
-        # TrainStep on the module — each fit() re-creates the optimizer, and
-        # rebuilding the step would recompile every call
+        self._policy = policy
+        # one XLA program per (optimizer config, precision policy): cache
+        # the compiled TrainStep on the module — each fit() re-creates the
+        # optimizer, and rebuilding the step would recompile every call.
+        # The policy is PART of the key: toggling MXNET_AMP between fit()
+        # calls must land on a fresh compile, not silently reuse the
+        # program compiled under the old precision (mxlint JIT001's
+        # stale-cache hazard, at the TrainStep-cache level)
         opt = module._optimizer
+        # num_update/begin_num_update are STEP STATE, not optimizer config
+        # — they advance during training, and keying on them forced a full
+        # recompile on every fit() after the first (the counters are
+        # re-imported into the TrainStep separately)
         key = (type(opt).__name__,
                tuple(sorted((k, v) for k, v in vars(opt).items()
-                            if isinstance(v, (int, float, bool, str)))),
+                            if isinstance(v, (int, float, bool, str))
+                            and k not in ("num_update",
+                                          "begin_num_update"))),
                tuple(sorted(getattr(opt, "lr_mult", {}).items())),
-               tuple(sorted(getattr(opt, "wd_mult", {}).items())))
+               tuple(sorted(getattr(opt, "wd_mult", {}).items())),
+               policy.key() if policy is not None else None)
         cached = getattr(module, "_fused_ts_cache", None)
         if cached is not None and cached[0] == key:
             self._ts = cached[1]
@@ -475,13 +498,19 @@ class _FusedFit(object):
         else:
             self._ts = TrainStep(module._symbol, opt,
                                  data_names=tuple(module._data_names),
-                                 label_names=tuple(module._label_names))
+                                 label_names=tuple(module._label_names),
+                                 policy=policy)
             module._fused_ts_cache = (key, self._ts)
         # the fit loop runs its own sentinel with epoch/nbatch context —
         # a step-level raise would hide the batch index
         self._ts.check_numerics = False
+        # the fit loop owns AMP telemetry (train_loss_scale + gauge +
+        # counter at the scalar_due cadence) — one sync, not two
+        self._ts._amp_emit = False
         dev = module._context[0].jax_device()
         self._dev = dev
+        # loss-scale state follows the params onto the module's device
+        self._ts._scale_device = dev
         arg_params, aux_params = module.get_params()
         self._params = {n: jax.device_put(arg_params[n].asnumpy(), dev)
                         for n in self._ts.param_names}
@@ -527,6 +556,48 @@ class _FusedFit(object):
         if counts:
             self._ts.num_update = max(counts.values())
 
+    def _host_batch(self, data_batch):
+        """DataBatch -> {input_name: host array} in TrainStep input order."""
+        import numpy as _np
+        arrays = list(data_batch.data) + list(data_batch.label or [])
+        # hand pjit HOST buffers: a CPU-committed jax array would be copied
+        # cross-device synchronously at dispatch; numpy stages async
+        return {n: (_np.asarray(a.value) if a.context.device_type == "cpu"
+                    else a.value)
+                for n, a in zip(self._input_names, arrays)}
+
+    def _stage(self, data_batch):
+        """Producer-side staging (runs on the DevicePrefetchIter thread):
+        issue the device_put for the whole batch onto the step's device so
+        the host->HBM copy overlaps the previous step's compute.  The
+        staged arrays ride on the DataBatch (`_staged`); everything else
+        (pad, labels for callbacks) stays as the loader produced it."""
+        import jax
+        data_batch._staged = {n: jax.device_put(v, self._dev)
+                              for n, v in self._host_batch(data_batch)
+                              .items()}
+        return data_batch
+
+    def prefetch(self, data_iter):
+        """Wrap an epoch's batch iterator in the depth-2 device prefetcher
+        (MXNET_DEVICE_PREFETCH; the fit loop's existing ``data_wait`` span
+        times the queue fetch, so the overlap win is directly visible in
+        telemetry).  Returns ``data_iter`` unchanged when disabled or when
+        a sequence mesh is active (those batches need mesh placement, which
+        the step's own dispatch handles)."""
+        from .. import io as _io
+        from ..parallel import mesh as _mesh
+        depth = _io.device_prefetch_depth()
+        if depth == 0 or _mesh.sequence_mesh()[0] is not None:
+            return data_iter
+        return _io.DevicePrefetchIter(data_iter, stage=self._stage,
+                                      depth=depth)
+
+    def amp_stats(self):
+        """(loss_scale, overflow_delta) under a precision policy, else
+        None.  Syncs two scalars — callers gate on telemetry."""
+        return self._ts.amp_stats()
+
     def step(self, data_batch):
         """One fused step; returns (outputs, device_labels) as NDArrays.
 
@@ -534,13 +605,9 @@ class _FusedFit(object):
         metric can reduce on device (one scalar transfer per batch instead
         of full-tensor round trips — the dominant cost on a tunneled TPU)."""
         import jax
-        import numpy as _np
-        arrays = list(data_batch.data) + list(data_batch.label or [])
-        # hand pjit HOST buffers: a CPU-committed jax array would be copied
-        # cross-device synchronously at dispatch; numpy stages async
-        batch = {n: (_np.asarray(a.value) if a.context.device_type == "cpu"
-                     else a.value)
-                 for n, a in zip(self._input_names, arrays)}
+        batch = getattr(data_batch, "_staged", None)
+        if batch is None:
+            batch = self._host_batch(data_batch)
         self._params, self._state, self._aux, outs = self._ts(
             self._params, self._state, self._aux, batch)
         # current weights now live in the fused pytrees, not the executors —

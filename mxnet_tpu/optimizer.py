@@ -42,7 +42,16 @@ def register(klass):
 
 
 class Optimizer(object):
-    """Base optimizer (parity: optimizer.py Optimizer)."""
+    """Base optimizer (parity: optimizer.py Optimizer).
+
+    ``rescale_grad`` (conventionally ``1/batch_size``) is applied inside
+    each update rule, exactly once.  Under a mixed-precision policy
+    (mxnet_tpu/amp.py) the fused TrainStep additionally UNSCALES the
+    loss-scaled gradients by ``1/loss_scale`` *before* they reach the
+    rule, so the two factors compose and neither is ever applied twice —
+    do NOT fold the loss scale into ``rescale_grad`` yourself (the
+    dynamic scale is traced jit state; ``rescale_grad`` is a trace-time
+    constant baked into the compiled update)."""
 
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,

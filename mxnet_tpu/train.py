@@ -244,14 +244,44 @@ class TrainStep(object):
     remat : False | True | 'dots' — rematerialisation policy for the backward
         pass (True = save nothing, 'dots' = save matmul outputs only)
     dtype : compute dtype for the lowered graph; params stay float32, inputs
-        and the graph run in this dtype (bfloat16 recommended on TPU)
+        and the graph run in this dtype (bfloat16 recommended on TPU).
+        Pure cast mode — no loss scaling; superseded by ``policy``.
+    policy : amp.Policy | True | dtype-str — full mixed-precision policy:
+        compute dtype + f32 master weights + (dynamic) loss scaling.  The
+        loss-scale state (current scale, good-step counter, overflow
+        count) is carried INSIDE the donated step jit — the scale is
+        injected at the loss heads (executor scale-backward identity, so
+        the whole backward chain sees it), non-finite grads are detected
+        on device, and the update is skipped in a ``lax.cond`` — so the
+        hot path stays sync-free.  Resolve env levers with
+        ``amp.resolve_policy()`` at construction time.
     """
 
     def __init__(self, symbol, optimizer, data_names=("data",),
                  label_names=("softmax_label",), mesh=None,
-                 param_shardings=None, remat=False, dtype=None, zero=False):
+                 param_shardings=None, remat=False, dtype=None, zero=False,
+                 policy=None):
         import jax
         from .executor import _Lowered
+        if policy is not None:
+            from . import amp as _amp
+            if dtype is not None:
+                raise MXNetError(
+                    "TrainStep: pass either dtype= (pure cast) or policy= "
+                    "(cast + loss scaling), not both")
+            policy = _amp.resolve_policy(policy)
+            if policy.compute_dtype != "float32":
+                dtype = policy.compute_dtype
+        self.policy = policy
+        self._has_scale = policy is not None
+        self._scale_state = None
+        self._scale_device = None
+        self._overflow_seen = 0
+        # who stamps the loss_scale gauge/overflow counter under
+        # telemetry: standalone TrainStep users get it from __call__;
+        # the fused fit loop takes ownership (one sampled sync, plus the
+        # train_loss_scale curve) and flips this off
+        self._amp_emit = True
         self.symbol = symbol
         self.mesh = mesh
         self.param_shardings = dict(param_shardings or {})
@@ -290,7 +320,7 @@ class TrainStep(object):
         self._dp = int(mesh.shape["dp"]) if self.zero else 1
         low = self._low
 
-        def fwd(params, aux, batch, rng):
+        def fwd(params, aux, batch, rng, head_scale=None):
             vals = dict(batch)
             if dtype is not None:
                 # cast only the data inputs — labels carry class ids that
@@ -303,7 +333,8 @@ class TrainStep(object):
                 params = {k: v.astype(dtype) for k, v in params.items()}
             vals.update(params)
             outs, aux_upd = low.run(vals, aux, rng, True,
-                                    no_grad_inputs=inputs)
+                                    no_grad_inputs=inputs,
+                                    head_grad_scale=head_scale)
             return tuple(outs), aux_upd
 
         if remat:
@@ -361,9 +392,58 @@ class TrainStep(object):
                             for k, v in aux_upd.items() if k in aux})
             return new_params, new_state, new_aux, outs
 
-        self._step_fn = step
+        def step_amp(params, opt_state, aux, lsc, batch, rng, hyper, t):
+            """Loss-scaled step: the scale state ``lsc`` rides donated in
+            the jit (and through run_steps' scan carry) — no host syncs."""
+            import jax.numpy as jnp
+
+            scale = lsc["scale"]
+
+            def f(p):
+                # the scale is injected at the loss heads (executor's
+                # scale-backward identity): the heads ignore incoming
+                # cotangents, so seeding would not reach the chain
+                return fwd(p, aux, batch, rng, scale)
+            outs, vjp_fn, aux_upd = jax.vjp(f, params, has_aux=True)
+            ones = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+            grads = vjp_fn(ones)[0]
+            # overflow detection on the SCALED f32 grads, on device
+            finite = jnp.stack(
+                [jnp.isfinite(g).all()
+                 for g in jax.tree_util.tree_leaves(grads)]).all()
+            inv = jnp.float32(1.0) / scale
+            upd = update_zero if self.zero else update_all
+
+            def do_update(_):
+                # unscale by 1/S exactly once; the optimizer's own
+                # rescale_grad (1/batch) applies inside the rule as always
+                grads_u = {n: g * inv.astype(g.dtype)
+                           for n, g in grads.items()}
+                new_params, new_state = upd(params, grads_u, opt_state,
+                                            hyper, t, rng)
+                new_aux = dict(aux)
+                new_aux.update({k: v.astype(aux[k].dtype)
+                                for k, v in aux_upd.items() if k in aux})
+                return new_params, new_state, new_aux
+
+            def skip_update(_):
+                # overflow step: weights, optimizer state AND the BN
+                # moving stats all stay put (inf activations must not
+                # poison running statistics)
+                return params, opt_state, dict(aux)
+
+            new_params, new_state, new_aux = jax.lax.cond(
+                finite, do_update, skip_update, None)
+            new_lsc = self.policy.next_state(lsc, finite)
+            # the loss surface crosses back in f32 (metrics, sentinels)
+            outs = tuple(o.astype(jnp.float32) for o in outs)
+            return new_params, new_state, new_aux, new_lsc, outs
+
+        self._step_fn = step_amp if self._has_scale else step
+        self._donate = (0, 1, 2, 3) if self._has_scale else (0, 1, 2)
         self._multi_cache = {}
         self._in_shardings = None
+        self._out_shardings = None
         if mesh is not None:
             from jax.sharding import NamedSharding
             ps = dict(param_shardings or {})
@@ -376,13 +456,33 @@ class TrainStep(object):
                         for n in inputs}
             state_sh = NamedSharding(mesh, _pspec("dp")) if self.zero \
                 else None
-            self._in_shardings = (param_sh, state_sh, None, batch_sh, rep,
-                                  None, None)
-            self._step = jax.jit(
-                step,
-                in_shardings=self._in_shardings,
-                donate_argnums=(0, 1, 2),
-                compiler_options=_xla_options())
+            if self._has_scale:
+                self._in_shardings = (param_sh, state_sh, None, rep,
+                                      batch_sh, rep, None, None)
+                # the lax.cond (skip-on-overflow) defeats GSPMD's output
+                # sharding propagation — pin the outputs to the input
+                # layout so the carried pytrees re-enter the next step
+                # without resharding
+                state_out = NamedSharding(mesh, _pspec("dp")) if self.zero \
+                    else param_sh
+                self._out_shardings = (param_sh, state_out, rep, rep, None)
+                self._step = jax.jit(
+                    step_amp,
+                    in_shardings=self._in_shardings,
+                    out_shardings=self._out_shardings,
+                    donate_argnums=(0, 1, 2, 3),
+                    compiler_options=_xla_options())
+            else:
+                self._in_shardings = (param_sh, state_sh, None, batch_sh,
+                                      rep, None, None)
+                self._step = jax.jit(
+                    step,
+                    in_shardings=self._in_shardings,
+                    donate_argnums=(0, 1, 2),
+                    compiler_options=_xla_options())
+        elif self._has_scale:
+            self._step = jax.jit(step_amp, donate_argnums=(0, 1, 2, 3),
+                                 compiler_options=_xla_options())
         else:
             self._step = jax.jit(step, donate_argnums=(0, 1, 2),
                                  compiler_options=_xla_options())
@@ -411,6 +511,48 @@ class TrainStep(object):
         for d in shape:
             size *= d
         return jnp.reshape(jnp.reshape(xf, (-1,))[:size], shape)
+
+    # ------------------------------------------------------------ loss scale
+    def _scale_state_dev(self):
+        """Current loss-scale state as device arrays (lazy first placement:
+        replicated on the mesh / sequence mesh, else the ambient or
+        explicitly-set compute device).  Donated into every step; the
+        returned state replaces it."""
+        if self._scale_state is not None:
+            return self._scale_state
+        import jax
+        host = self.policy.init_state()
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+            dst = NamedSharding(self.mesh, _pspec())
+        else:
+            dst = _seq_replicated_sharding()
+            if dst is None:
+                if self._scale_device is not None:
+                    dst = self._scale_device
+                else:
+                    from .context import Context
+                    ambient = getattr(Context._default_ctx, "value", None)
+                    dst = (ambient.jax_device() if ambient is not None
+                           else jax.devices()[0])
+        self._scale_state = {k: jax.device_put(v, dst)
+                             for k, v in host.items()}
+        return self._scale_state
+
+    def amp_stats(self):
+        """Host view of the loss-scale state: ``(scale, overflow_delta)``
+        with the overflow (skipped-update) count as a delta since the
+        previous call, or None without a policy.  Syncs two scalars —
+        call only under a telemetry/diagnostics gate, never per hot-path
+        step."""
+        if not self._has_scale or self._scale_state is None:
+            return None
+        import jax
+        host = jax.device_get(self._scale_state)
+        total = int(host["overflow"])
+        delta = total - self._overflow_seen
+        self._overflow_seen = total
+        return float(host["scale"]), delta
 
     # ------------------------------------------------------------------- init
     def init(self, data_shapes, label_shapes=None, initializer=None, seed=0):
@@ -558,39 +700,71 @@ class TrainStep(object):
         fn = self._multi_cache.get((num_steps, stacked))
         if fn is None:
             step = self._step_fn
-
-            def many(params, opt_state, aux, batch, rng, hyper, t0):
-                def body(carry, i):
-                    p, s, a = carry
-                    sub = jax.random.fold_in(rng, i)
-                    b = jax.tree_util.tree_map(lambda x: x[i], batch) \
-                        if stacked else batch
-                    p, s, a, outs = step(p, s, a, b, sub, hyper, t0 + i + 1)
-                    return (p, s, a), None
-                (p, s, a), _ = jax.lax.scan(
-                    body, (params, opt_state, aux),
-                    jax.numpy.arange(num_steps))
-                # one extra step emitting outputs (keeps scan carry lean)
-                last = jax.tree_util.tree_map(lambda x: x[num_steps], batch) \
-                    if stacked else batch
-                return step(p, s, a, last, rng, hyper, t0 + num_steps + 1)
+            if self._has_scale:
+                # the loss-scale state rides in the scan carry: overflow
+                # steps inside a fused chunk skip their update and halve
+                # the scale exactly like sequential stepping
+                def many(params, opt_state, aux, lsc, batch, rng, hyper,
+                         t0):
+                    def body(carry, i):
+                        p, s, a, l = carry
+                        sub = jax.random.fold_in(rng, i)
+                        b = jax.tree_util.tree_map(lambda x: x[i], batch) \
+                            if stacked else batch
+                        p, s, a, l, outs = step(p, s, a, l, b, sub, hyper,
+                                                t0 + i + 1)
+                        return (p, s, a, l), None
+                    (p, s, a, l), _ = jax.lax.scan(
+                        body, (params, opt_state, aux, lsc),
+                        jax.numpy.arange(num_steps))
+                    last = jax.tree_util.tree_map(
+                        lambda x: x[num_steps], batch) if stacked else batch
+                    return step(p, s, a, l, last, rng, hyper,
+                                t0 + num_steps + 1)
+            else:
+                def many(params, opt_state, aux, batch, rng, hyper, t0):
+                    def body(carry, i):
+                        p, s, a = carry
+                        sub = jax.random.fold_in(rng, i)
+                        b = jax.tree_util.tree_map(lambda x: x[i], batch) \
+                            if stacked else batch
+                        p, s, a, outs = step(p, s, a, b, sub, hyper,
+                                             t0 + i + 1)
+                        return (p, s, a), None
+                    (p, s, a), _ = jax.lax.scan(
+                        body, (params, opt_state, aux),
+                        jax.numpy.arange(num_steps))
+                    # one extra step emitting outputs (keeps scan carry
+                    # lean)
+                    last = jax.tree_util.tree_map(
+                        lambda x: x[num_steps], batch) if stacked else batch
+                    return step(p, s, a, last, rng, hyper,
+                                t0 + num_steps + 1)
 
             if self.mesh is not None:
                 shardings = self._in_shardings
+                bi = 4 if self._has_scale else 3   # batch slot
                 if stacked:
                     # batch leaves carry a leading step axis; dp shards axis 1
                     from jax.sharding import NamedSharding
                     batch_sh = {n: NamedSharding(self.mesh,
                                                  _pspec(None, "dp"))
-                                for n in shardings[3]}
-                    shardings = shardings[:3] + (batch_sh,) + shardings[4:]
+                                for n in shardings[bi]}
+                    shardings = shardings[:bi] + (batch_sh,) \
+                        + shardings[bi + 1:]
                 fn = jax.jit(many, in_shardings=shardings,
-                             donate_argnums=(0, 1, 2),
+                             out_shardings=self._out_shardings,
+                             donate_argnums=self._donate,
                              compiler_options=_xla_options())
             else:
-                fn = jax.jit(many, donate_argnums=(0, 1, 2),
+                fn = jax.jit(many, donate_argnums=self._donate,
                              compiler_options=_xla_options())
             self._multi_cache[(num_steps, stacked)] = fn
+        if self._has_scale:
+            res = fn(params, opt_state, aux, self._scale_state_dev(), batch,
+                     rng, hyper, _np.int32(t0))
+            self._scale_state = res[3]
+            return res[0], res[1], res[2], res[4]
         return fn(params, opt_state, aux, batch, rng, hyper,
                   _np.int32(t0))
 
@@ -604,20 +778,33 @@ class TrainStep(object):
             rng = _random.next_key()
         hyper = self.fopt.hyper(self.num_update)
         self.num_update += 1
+        args = (params, opt_state, aux)
+        if self._has_scale:
+            args = args + (self._scale_state_dev(),)
         with _profiler.Scope("train_step[%d]" % self.num_update, "symbolic"):
             if _tel._enabled:
                 with _tel.span("train_step", cat="executor", mirror=False,
                                num_update=self.num_update):
-                    res = self._step(params, opt_state, aux, batch, rng,
-                                     hyper, _np.int32(self.num_update))
+                    res = self._step(*args, batch, rng, hyper,
+                                     _np.int32(self.num_update))
                     import jax
-                    jax.block_until_ready(res[3])  # span reads device time
+                    jax.block_until_ready(res[-1])  # span reads device time
             else:
-                res = self._step(params, opt_state, aux, batch, rng, hyper,
+                res = self._step(*args, batch, rng, hyper,
                                  _np.int32(self.num_update))
                 if _profiler.is_running():
                     import jax
-                    jax.block_until_ready(res[3])
+                    jax.block_until_ready(res[-1])
+        if self._has_scale:
+            self._scale_state = res[3]
+            res = (res[0], res[1], res[2], res[4])
+            if _tel._enabled and self._amp_emit \
+                    and _tel.scalar_due(self.num_update):
+                # bounded telemetry sync: scale gauge + overflow counter
+                scale, overflow = self.amp_stats()
+                _tel.gauge("loss_scale", scale)
+                if overflow:
+                    _tel.counter("amp_overflow_steps", overflow)
         if _diag._armed:
             _diag.heartbeat(train_step=self.num_update)
         mode = _diag.check_numerics_mode() if self.check_numerics else None
@@ -634,9 +821,19 @@ class EvalStep(object):
     forward-only executor, reference src/c_api/c_predict_api.cc)."""
 
     def __init__(self, symbol, mesh=None, dtype=None,
-                 label_names=("softmax_label",)):
+                 label_names=("softmax_label",), policy=None):
         import jax
         from .executor import _Lowered
+        if policy is not None:
+            # forward-only: the policy contributes its compute dtype (no
+            # loss scaling without a backward pass)
+            from . import amp as _amp
+            if dtype is not None:
+                raise MXNetError(
+                    "EvalStep: pass either dtype= or policy=, not both")
+            policy = _amp.resolve_policy(policy)
+            if policy.compute_dtype != "float32":
+                dtype = policy.compute_dtype
         low = _Lowered(symbol)
         self._low = low
         self.mesh = mesh

@@ -201,3 +201,76 @@ def test_smart_open_remote_uris():
     r = recordio.MXRecordIO("memory://sm/t.rec", "r")
     assert r.read() == b"alpha" and r.read() == b"beta" and r.read() is None
     r.close()
+
+
+def test_device_prefetch_depth_env(monkeypatch):
+    """MXNET_DEVICE_PREFETCH: unset/1 -> 2 (double buffering), 0 -> off,
+    N>=2 -> N, junk -> loud error."""
+    monkeypatch.delenv("MXNET_DEVICE_PREFETCH", raising=False)
+    assert mx.io.device_prefetch_depth() == 2
+    monkeypatch.setenv("MXNET_DEVICE_PREFETCH", "1")
+    assert mx.io.device_prefetch_depth() == 2
+    monkeypatch.setenv("MXNET_DEVICE_PREFETCH", "0")
+    assert mx.io.device_prefetch_depth() == 0
+    monkeypatch.setenv("MXNET_DEVICE_PREFETCH", "5")
+    assert mx.io.device_prefetch_depth() == 5
+    monkeypatch.setenv("MXNET_DEVICE_PREFETCH", "two")
+    import pytest
+    with pytest.raises(mx.base.MXNetError):
+        mx.io.device_prefetch_depth()
+
+
+def test_device_prefetch_iter_orders_and_stages():
+    staged_on = []
+
+    def stage(x):
+        staged_on.append(__import__("threading").current_thread().name)
+        return x * 10
+
+    it = mx.io.DevicePrefetchIter(iter(range(6)), stage=stage)
+    assert list(it) == [0, 10, 20, 30, 40, 50]
+    # staging ran on the producer thread, not the consumer
+    import threading
+    assert staged_on and all(n != threading.main_thread().name
+                             for n in staged_on)
+    # exhausted: further next() keeps raising StopIteration
+    import pytest
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_device_prefetch_iter_forwards_exceptions():
+    import pytest
+
+    def gen():
+        yield 1
+        raise ValueError("loader died")
+
+    it = mx.io.DevicePrefetchIter(gen())
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="loader died"):
+        next(it)
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_device_prefetch_iter_stage_error_forwarded():
+    import pytest
+
+    def bad_stage(x):
+        raise RuntimeError("device_put failed")
+
+    it = mx.io.DevicePrefetchIter(iter([1, 2]), stage=bad_stage)
+    with pytest.raises(RuntimeError, match="device_put failed"):
+        next(it)
+
+
+def test_device_prefetch_iter_drain_unblocks_producer():
+    """drain() must terminate a producer blocked on a full queue."""
+    it = mx.io.DevicePrefetchIter(iter(range(100)), depth=2)
+    assert next(it) == 0
+    it.drain()
+    assert not it._thread.is_alive()
+    import pytest
+    with pytest.raises(StopIteration):
+        next(it)
