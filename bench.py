@@ -161,6 +161,116 @@ def measure_data_wait(ts, params, state, aux, host_batch, chunk, chunks=2,
     return stats
 
 
+def bench_serving(n_clients=24, requests_per_client=40, max_batch=16,
+                  wait_ms=2.0, dim=256, hidden=512, classes=64, seed=0):
+    """Serving round: N synthetic concurrent clients against the dynamic
+    bucketed-batching server (mxnet_tpu/serving.py) vs the serialized
+    one-at-a-time baseline (a single batch-1 ``Predictor`` behind a lock
+    — the pre-serving inference story), at equal request count.
+
+    Clients fire their next request as soon as the previous one resolves,
+    so the batcher sees continuous load and steady-state batch size
+    approaches the outstanding-client count (capped at ``max_batch``).
+    Returns the record stamped into BENCH json under ``"serving"``:
+    client-observed ``serve_qps`` / ``serve_p50_ms`` / ``serve_p99_ms``
+    and the batched-vs-serialized ratio ``serve_speedup`` as gated
+    metrics (``tools/run_compare.py --check``, like the training
+    numbers); the serialized baseline's absolute qps and the mean batch
+    occupancy (requests / bucket slots) ride the ``config`` context
+    block — informative, never gated."""
+    import threading
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.predictor import Predictor
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="sfc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=hidden, name="sfc2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="sfc3")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(seed)
+    shapes, _, _ = net.infer_shape(data=(1, dim))
+    params = {n: mx.nd.array((rng.randn(*s) * 0.05).astype(np.float32))
+              for n, s in zip(net.list_arguments(), shapes)
+              if n not in ("data", "softmax_label")}
+    x = rng.uniform(-1, 1, (n_clients, requests_per_client, dim)) \
+        .astype(np.float32)
+
+    def drive(call):
+        """Client-observed latencies + wall time at equal request count.
+        A failed client invalidates the round loudly — a record computed
+        over silently-dropped requests would break the equal-request-
+        count premise the speedup gate stands on."""
+        lats = [[] for _ in range(n_clients)]
+        errors = []
+
+        def client(ci):
+            try:
+                for ri in range(requests_per_client):
+                    t0 = time.perf_counter()
+                    call(x[ci, ri])
+                    lats[ci].append(time.perf_counter() - t0)
+            except Exception as exc:   # surfaced after join
+                errors.append(exc)
+
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        flat = sorted(v for l in lats for v in l)
+        n = len(flat)
+        assert n == n_clients * requests_per_client
+        return {"qps": n / wall, "p50_ms": flat[n // 2] * 1e3,
+                "p99_ms": flat[min(n - 1, int(n * 0.99))] * 1e3}
+
+    # serialized baseline: every request pays its own batch-1 forward,
+    # one at a time (warmed so the jit compile is outside the clock)
+    p1 = Predictor(net, params, {"data": (1, dim)})
+    p1.forward(data=x[0, 0][None])
+    p1.get_output(0)
+    lock = threading.Lock()
+
+    def serial_call(row):
+        with lock:
+            p1.forward(data=row[None])
+            p1.get_output(0)
+
+    serial = drive(serial_call)
+
+    model = serving.ServedModel(net, params, {"data": (dim,)}, name="bench",
+                                max_batch=max_batch, max_wait_ms=wait_ms)
+    model.warm()   # whole ladder compiled before the clock starts
+    batched = drive(lambda row: model.predict({"data": row}, timeout=60.0))
+    stats = model.stats()
+    model.close()
+
+    # gated metrics at the top level (run_compare --check); context that
+    # must NOT trip the gate — the serialized baseline's noise-sensitive
+    # absolute qps, and occupancy (which legitimately drops when a faster
+    # forward drains the queue before buckets fill) — rides config
+    return {
+        "serve_qps": round(batched["qps"], 1),
+        "serve_p50_ms": round(batched["p50_ms"], 3),
+        "serve_p99_ms": round(batched["p99_ms"], 3),
+        "serve_speedup": round(batched["qps"] / serial["qps"], 2),
+        "config": {"clients": n_clients,
+                   "requests": n_clients * requests_per_client,
+                   "max_batch": max_batch, "wait_ms": wait_ms,
+                   "model": "mlp%dx%d" % (dim, hidden),
+                   "serve_qps_serial": round(serial["qps"], 1),
+                   "serve_batch_occupancy": round(stats["occupancy"], 4),
+                   "batches_by_bucket": stats["batches_by_bucket"]},
+    }
+
+
 def telemetry_summary():
     """Tail-latency summary from the live telemetry registry (None while
     telemetry is off): p50/p99/mean per step-like histogram — the bench's
@@ -229,6 +339,9 @@ def main():
     # measured input-pipeline shares (prefetch on vs synchronous staging)
     summary.update(pipeline)
     rec["telemetry"] = summary
+    # serving round: concurrent batched server vs serialized baseline
+    # (run_compare ingests the numeric fields as gated metrics)
+    rec["serving"] = bench_serving()
     print(json.dumps(rec))
 
 

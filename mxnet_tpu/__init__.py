@@ -46,6 +46,7 @@ from . import recordio
 from . import profiler
 from . import engine
 from . import predictor
+from . import serving
 from . import rtc
 from .predictor import Predictor
 from . import rnn

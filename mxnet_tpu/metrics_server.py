@@ -39,7 +39,7 @@ from .base import get_env
 from . import telemetry as _tel
 
 __all__ = ["start_server", "stop_server", "server_port",
-           "prometheus_text", "json_snapshot"]
+           "prometheus_text", "json_snapshot", "parse_endpoint"]
 
 _lock = threading.Lock()
 _server = None
@@ -173,13 +173,18 @@ class _Handler(BaseHTTPRequestHandler):
         seconds must not flood the training log."""
 
 
-def _parse_endpoint(value):
-    """``MXNET_METRICS_PORT`` carries ``<port>`` or ``<host>:<port>``;
-    returns (host, port) with host defaulting to ``127.0.0.1``.  Raises
-    ValueError on a malformed value."""
+def parse_endpoint(value):
+    """``MXNET_METRICS_PORT`` / ``MXNET_SERVE_PORT`` carry ``<port>`` or
+    ``<host>:<port>``; returns (host, port) with host defaulting to
+    ``127.0.0.1``.  Raises ValueError on a malformed value.  Shared with
+    the serving front end (serving.py) so both endpoints speak the same
+    env dialect."""
     value = str(value).strip()
     host, sep, port = value.rpartition(":")
     return (host if sep else "") or "127.0.0.1", int(port)
+
+
+_parse_endpoint = parse_endpoint
 
 
 def start_server(port=None, host=None):

@@ -14,7 +14,19 @@ from .base import MXNetError
 from . import ndarray as nd
 from . import symbol as sym_mod
 
-__all__ = ["Predictor"]
+__all__ = ["Predictor", "read_checkpoint"]
+
+
+def read_checkpoint(prefix, epoch):
+    """``(symbol_json, params_blob)`` of a ``save_checkpoint`` pair
+    (``prefix-symbol.json`` + ``prefix-%04d.params``) — the one place the
+    checkpoint file layout is known; ``Predictor.from_checkpoint`` and
+    ``serving.Server.register_checkpoint`` both load through it."""
+    with open("%s-symbol.json" % prefix) as f:
+        sym_json = f.read()
+    with open("%s-%04d.params" % (prefix, epoch), "rb") as f:
+        blob = f.read()
+    return sym_json, blob
 
 
 class Predictor(object):
@@ -26,10 +38,21 @@ class Predictor(object):
     param_blob : dict of params, a ``.params`` path, or raw bytes of one
     input_shapes : {name: shape} for all data inputs
     dev_type / dev_id : placement (parity: MXPredCreate signature)
+    input_types : optional {name: dtype} for data inputs that are not
+        float32 (embedding id streams, pre-cast bf16 activations); the
+        input binds — and ``set_input`` stages — at that dtype.
+    copy_params : default True (each binding owns a private copy of the
+        weights, reference semantics).  ``False`` binds param NDArrays
+        already resident on the target device as-is — safe because a
+        forward-only executor never writes its weight/aux args (jax
+        arrays are immutable), and what lets the serving bucket ladder
+        (serving.py) share ONE device-resident weight set across every
+        batch-size binding instead of one copy per rung.
     """
 
     def __init__(self, symbol, param_blob, input_shapes, dev_type="cpu",
-                 dev_id=0, output_names=None):
+                 dev_id=0, output_names=None, input_types=None,
+                 copy_params=True):
         from .context import Context
         if isinstance(symbol, (str, bytes)):
             symbol = sym_mod.load_json(
@@ -64,18 +87,31 @@ class Predictor(object):
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         self._input_names = list(input_shapes)
+        input_types = {k: _np.dtype(v)
+                       for k, v in (input_types or {}).items()}
+        unknown_types = set(input_types) - set(input_shapes)
+        if unknown_types:
+            raise MXNetError("input_types names non-inputs %s"
+                             % sorted(unknown_types))
         # params not in the blob (e.g. the loss head's label input) bind as
         # zeros — reference c_predict_api.cc:191-195 does exactly this
+        def place(p):
+            if not copy_params and p.context == ctx:
+                return p   # share the device-resident array (read-only)
+            return p.copyto(ctx)
+
         args = {}
         for name, shape in zip(arg_names, arg_shapes):
             if name in arg_params and name not in input_shapes:
-                args[name] = arg_params[name].copyto(ctx)
+                args[name] = place(arg_params[name])
             else:
-                args[name] = nd.zeros(shape, ctx=ctx)
+                args[name] = nd.zeros(shape, ctx=ctx,
+                                      dtype=input_types.get(name,
+                                                            _np.float32))
         auxs = {}
         for name, shape in zip(aux_names, aux_shapes):
             if name in aux_params:
-                auxs[name] = aux_params[name].copyto(ctx)
+                auxs[name] = place(aux_params[name])
             else:
                 auxs[name] = nd.zeros(shape, ctx=ctx)
         self._executor = symbol.bind(ctx, args, aux_states=auxs,
@@ -84,35 +120,49 @@ class Predictor(object):
 
     # ------------------------------------------------------------------- api
     def set_input(self, name, value):
-        """(parity: MXPredSetInput).  While telemetry records, the host→
+        """(parity: MXPredSetInput).  The value stages at the BOUND
+        argument's dtype (an int32 id stream or a bf16 input binding never
+        round-trips through a forced float32 host cast — large ids would
+        silently lose precision).  While telemetry records, the host→
         device staging copy is timed as a ``predict.set_input`` span (the
         serving analogue of the fit loop's ``load_data``)."""
         if name not in self._input_names:
             raise MXNetError("unknown input %s (have %s)"
                              % (name, self._input_names))
+        arr = self._executor.arg_dict[name]
         from . import telemetry as _tel
         if _tel._enabled:
             with _tel.span("predict.set_input", cat="serve", input=name):
-                self._executor.arg_dict[name][:] = \
-                    _np.asarray(value, dtype=_np.float32)
+                arr[:] = _np.asarray(value, dtype=arr.dtype)
         else:
-            self._executor.arg_dict[name][:] = _np.asarray(value,
-                                                           dtype=_np.float32)
+            arr[:] = _np.asarray(value, dtype=arr.dtype)
 
-    def forward(self):
-        """(parity: MXPredForward).  While telemetry records, each request
-        is a ``predict.forward`` span (histogram-backed — the executor
-        blocks on its result while recording, so the span is true serving
-        latency, and ``quantile("predict.forward", 0.99)``, the metrics
-        endpoint, and the fleet report all see the tail) plus
-        ``predict_requests``/``predict_samples`` counters.  Strict no-op
-        when telemetry is disabled."""
+    def forward(self, **inputs):
+        """(parity: MXPredForward).  Keyword arguments are batched input
+        staging — ``forward(data=batch)`` stages every given input (each
+        at its bound dtype, exactly like ``set_input``) and runs the
+        forward in one call; the serving batcher (serving.py) uses this
+        so a coalesced tick is a single predictor invocation.  While
+        telemetry records, each call is a ``predict.forward`` span
+        (histogram-backed — the executor blocks on its result while
+        recording, so the span is true serving latency, and
+        ``quantile("predict.forward", 0.99)``, the metrics endpoint, and
+        the fleet report all see the tail) plus ``predict_requests``/
+        ``predict_samples`` counters.  Strict no-op when telemetry is
+        disabled."""
+        staged = {}
+        for name, value in inputs.items():
+            if name not in self._input_names:
+                raise MXNetError("unknown input %s (have %s)"
+                                 % (name, self._input_names))
+            staged[name] = _np.asarray(
+                value, dtype=self._executor.arg_dict[name].dtype)
         from . import telemetry as _tel
         if not _tel._enabled:
-            self._outputs = self._executor.forward(is_train=False)
+            self._outputs = self._executor.forward(is_train=False, **staged)
             return
         with _tel.span("predict.forward", cat="serve"):
-            self._outputs = self._executor.forward(is_train=False)
+            self._outputs = self._executor.forward(is_train=False, **staged)
         _tel.counter("predict_requests")
         if self._input_names:
             _tel.counter("predict_samples", int(
@@ -152,13 +202,14 @@ class Predictor(object):
     # ------------------------------------------------------------- factories
     @staticmethod
     def from_checkpoint(prefix, epoch, input_shapes, dev_type="cpu",
-                        dev_id=0):
-        """Load ``prefix-symbol.json`` + ``prefix-%04d.params``."""
-        with open("%s-symbol.json" % prefix) as f:
-            sym_json = f.read()
-        with open("%s-%04d.params" % (prefix, epoch), "rb") as f:
-            blob = f.read()
-        return Predictor(sym_json, blob, input_shapes, dev_type, dev_id)
+                        dev_id=0, output_names=None, input_types=None):
+        """Load ``prefix-symbol.json`` + ``prefix-%04d.params``.
+        ``output_names`` reaches the partial-out feature-extraction
+        binding (MXPredCreatePartialOut parity), so internal-layer
+        outputs are reachable straight from checkpoint files."""
+        sym_json, blob = read_checkpoint(prefix, epoch)
+        return Predictor(sym_json, blob, input_shapes, dev_type, dev_id,
+                         output_names=output_names, input_types=input_types)
 
 
 def _load_params(param_blob):

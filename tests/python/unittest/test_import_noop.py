@@ -46,6 +46,7 @@ import mxnet_tpu.profiler
 import mxnet_tpu.io
 import mxnet_tpu.image
 import mxnet_tpu.engine
+import mxnet_tpu.serving
 
 new_threads = [t.name for t in threading.enumerate()
                if t.ident not in baseline_threads]

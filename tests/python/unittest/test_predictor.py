@@ -2,6 +2,7 @@
 forward-only bind from saved symbol+params, missing-arg zero fill, blob and
 checkpoint loading paths)."""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import models
@@ -53,6 +54,88 @@ def test_predictor_from_blob_bytes(tmp_path):
     pred.forward()
     assert pred.get_output(0).shape == (5, 4)
     assert pred.num_outputs == 1
+
+
+def test_set_input_stages_at_bound_dtype():
+    """satellite fix: set_input must stage at the BOUND arg's dtype — the
+    old forced float32 host cast silently rounded int values above 2^24
+    (and would up/down-cast any non-f32 binding)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Cast(data, dtype="int32")
+    pred = Predictor(net, {}, {"data": (2, 3)},
+                     input_types={"data": np.int32})
+    assert pred._executor.arg_dict["data"].dtype == np.int32
+    big = 2 ** 24 + 1   # not representable in float32
+    vals = np.array([[big, 1, 2], [3, 4, big + 2]], dtype=np.int64)
+    pred.set_input("data", vals)
+    pred.forward()
+    out = pred.get_output(0)
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out, vals.astype(np.int32))
+
+
+def test_forward_kwargs_batched_staging():
+    """forward(**inputs) stages every given input (at its bound dtype)
+    and runs in one call — the serving batcher's staging path."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.Cast(data, dtype="int32")
+    pred = Predictor(net, {}, {"data": (1, 2)},
+                     input_types={"data": np.int32})
+    pred.forward(data=np.array([[2 ** 24 + 1, 5]], dtype=np.int64))
+    np.testing.assert_array_equal(pred.get_output(0),
+                                  [[2 ** 24 + 1, 5]])
+    with pytest.raises(mx.MXNetError, match="unknown input"):
+        pred.forward(bogus=np.zeros((1, 2)))
+
+
+def test_predictor_bf16_input_binding():
+    """input_types binds a non-f32 input; f32 values stage down to the
+    binding's dtype instead of widening the binding to f32."""
+    import jax.numpy as jnp
+    data = mx.sym.Variable("data")
+    net = mx.sym.Cast(data, dtype="float32")
+    pred = Predictor(net, {}, {"data": (2, 4)},
+                     input_types={"data": jnp.bfloat16})
+    arr = pred._executor.arg_dict["data"]
+    assert str(arr.dtype) == "bfloat16"
+    x = RS(0).randn(2, 4).astype(np.float32)
+    pred.set_input("data", x)
+    assert str(arr.dtype) == "bfloat16"   # staging kept the binding dtype
+    pred.forward()
+    np.testing.assert_array_equal(
+        pred.get_output(0), x.astype(jnp.bfloat16).astype(np.float32))
+
+
+def test_predictor_input_types_rejects_non_inputs():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    with pytest.raises(mx.MXNetError, match="input_types"):
+        Predictor(net, {}, {"data": (1, 3)},
+                  input_types={"fc_weight": np.int32})
+
+
+def test_from_checkpoint_partial_out(tmp_path):
+    """satellite fix: from_checkpoint forwards output_names, so the
+    MXPredCreatePartialOut feature-extraction binding works straight from
+    checkpoint files."""
+    prefix, _, x, _ = _checkpoint(tmp_path)
+    feat = Predictor.from_checkpoint(prefix, 4, {"data": (5, 16)},
+                                     output_names=["fc1"])
+    feat.set_input("data", x[:5])
+    feat.forward()
+    out = feat.get_output(0)
+    assert out.shape == (5, 128)   # fc1 hidden width, not the 4-way head
+
+    # identical to the direct partial-out constructor path
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read()
+    with open(prefix + "-0004.params", "rb") as f:
+        blob = f.read()
+    direct = Predictor(sym_json, blob, {"data": (5, 16)},
+                       output_names=["fc1"])
+    direct.set_input("data", x[:5])
+    direct.forward()
+    np.testing.assert_array_equal(out, direct.get_output(0))
 
 
 def test_predictor_batchnorm_aux(tmp_path):

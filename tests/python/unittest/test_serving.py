@@ -1,0 +1,573 @@
+"""Serving-layer tests (mxnet_tpu/serving.py): dynamic bucketed batching,
+the padding-never-leaks bitwise contract, multi-model hosting, the HTTP
+front end, serving telemetry, and the bench/run_compare perf gate."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving, telemetry as tel
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.predictor import Predictor
+
+RS = np.random.RandomState
+
+
+def _mlp(num_classes=4, dim=16, seed=0):
+    """A small deterministic MLP: (symbol, params, per-sample dim)."""
+    from mxnet_tpu.models import mlp
+    sym = mlp.get_symbol(num_classes=num_classes)
+    rng = RS(seed)
+    shapes, _, _ = sym.infer_shape(data=(1, dim))
+    params = {n: mx.nd.array((rng.randn(*s) * 0.1).astype(np.float32))
+              for n, s in zip(sym.list_arguments(), shapes)
+              if n not in ("data", "softmax_label")}
+    return sym, params
+
+
+def _model(max_batch=8, max_wait_ms=200, **kwargs):
+    sym, params = _mlp()
+    return serving.ServedModel(sym, params, {"data": (16,)}, name="t",
+                               max_batch=max_batch, max_wait_ms=max_wait_ms,
+                               **kwargs), sym, params
+
+
+# ------------------------------------------------------------------- ladder
+def test_bucket_ladder():
+    assert serving.bucket_ladder(8) == [1, 2, 4, 8]
+    assert serving.bucket_ladder(6) == [1, 2, 4, 6]
+    assert serving.bucket_ladder(1) == [1]
+    assert serving.bucket_ladder(2) == [1, 2]
+    with pytest.raises(MXNetError):
+        serving.bucket_ladder(0)
+
+
+def test_custom_buckets_and_bucket_for():
+    model, _, _ = _model(buckets=[6, 2, 2])
+    try:
+        assert model.buckets == [2, 6]
+        assert model.max_batch == 6
+        assert model._bucket_for(1) == 2
+        assert model._bucket_for(3) == 6
+        assert model._bucket_for(6) == 6
+    finally:
+        model.close()
+    # an invalid rung is a loud error, not a silent filter (a [0, 8]
+    # typo must not quietly pad every lone request to 8)
+    with pytest.raises(MXNetError, match="bucket sizes"):
+        _model(buckets=[0, 8])
+    with pytest.raises(MXNetError, match="integers"):
+        _model(buckets=[2.5, 8])
+
+
+# --------------------------------------------------------------- validation
+def test_served_model_rejects_unknown_input_types():
+    sym, params = _mlp()
+    with pytest.raises(MXNetError, match="input_types"):
+        serving.ServedModel(sym, params, {"data": (16,)},
+                            input_types={"dta": np.int32})
+
+
+def test_invalid_env_defaults_ignored_when_overridden(monkeypatch):
+    """A bad MXNET_SERVE_* value must not break a model whose ctor
+    overrides that knob — the env is only read when it is needed."""
+    monkeypatch.setenv("MXNET_SERVE_MAX_BATCH", "0")
+    monkeypatch.setenv("MXNET_SERVE_WAIT_MS", "-5")
+    model, _, _ = _model(max_batch=4, max_wait_ms=1)   # overrides both
+    model.close()
+    with pytest.raises(MXNetError, match="MXNET_SERVE_WAIT_MS"):
+        _model(max_batch=4, max_wait_ms=None)
+    monkeypatch.setenv("MXNET_SERVE_WAIT_MS", "7")
+    with pytest.raises(MXNetError, match="MXNET_SERVE_MAX_BATCH"):
+        _model(max_batch=None, max_wait_ms=1)
+    model, _, _ = _model(max_batch=None, max_wait_ms=None, buckets=[2])
+    assert model._wait_s == pytest.approx(7e-3)   # valid env wait applies
+    model.close()
+
+
+def test_submit_validation():
+    model, _, _ = _model()
+    try:
+        with pytest.raises(MXNetError, match="missing input"):
+            model.submit({})
+        with pytest.raises(MXNetError, match="per-sample"):
+            model.submit({"data": np.zeros((2, 16), np.float32)})
+        with pytest.raises(MXNetError, match="unknown request inputs"):
+            model.submit({"data": np.zeros(16, np.float32), "bogus": 1})
+    finally:
+        model.close()
+    with pytest.raises(MXNetError, match="closed"):
+        model.submit({"data": np.zeros(16, np.float32)})
+    model.close()   # idempotent
+
+
+# ------------------------------------------------- batching & bitwise contract
+def test_coalesced_batch_byte_identical_to_padding_free_forward():
+    """5 in-flight requests coalesce into ONE bucket-8 forward whose
+    per-request rows are byte-identical to a padding-free Predictor
+    forward of the same 5 samples — the 3 padded rows never leak."""
+    model, sym, params = _model(max_wait_ms=300)
+    x = RS(1).randn(5, 16).astype(np.float32)
+    try:
+        futs = [model.submit({"data": x[i]}) for i in range(5)]
+        outs = [f.result(60) for f in futs]
+        st = model.stats()
+        assert st["batches"] == 1 and st["requests"] == 5
+        assert st["batches_by_bucket"] == {8: 1}
+        assert st["padded_slots"] == 3
+        assert st["occupancy"] == pytest.approx(5 / 8)
+        ref = Predictor(sym, params, {"data": (5, 16)})
+        ref.forward(data=x)
+        want = ref.get_output(0)
+        for i in range(5):
+            np.testing.assert_array_equal(outs[i][0], want[i])
+    finally:
+        model.close()
+
+
+def test_single_request_matches_unbatched_predictor_bitwise():
+    """A lone request rides the bucket-1 binding — the exact program an
+    unbatched Predictor runs — so the bytes agree."""
+    model, sym, params = _model(max_wait_ms=1)
+    x = RS(2).randn(16).astype(np.float32)
+    try:
+        out = model.predict({"data": x}, timeout=60)
+        st = model.stats()
+        assert st["batches_by_bucket"] == {1: 1}
+        assert st["padded_slots"] == 0
+        p1 = Predictor(sym, params, {"data": (1, 16)})
+        p1.forward(data=x[None])
+        np.testing.assert_array_equal(out[0], p1.get_output(0)[0])
+    finally:
+        model.close()
+
+
+def test_co_traffic_content_never_leaks():
+    """The same request served twice with DIFFERENT companions (same
+    bucket) returns bit-identical rows: neither the co-batched rows nor
+    the padding influence a request's result."""
+    model, _, _ = _model(max_wait_ms=300)
+    rng = RS(3)
+    probe = rng.randn(16).astype(np.float32)
+    try:
+        rounds = []
+        for _ in range(2):
+            mates = rng.randn(2, 16).astype(np.float32)   # fresh each time
+            futs = [model.submit({"data": probe})] + \
+                   [model.submit({"data": mates[i]}) for i in range(2)]
+            rounds.append(futs[0].result(60))
+            for f in futs[1:]:
+                f.result(60)
+        st = model.stats()
+        assert st["batches_by_bucket"] == {4: 2}   # n=3 -> bucket 4, twice
+        np.testing.assert_array_equal(rounds[0][0], rounds[1][0])
+    finally:
+        model.close()
+
+
+def test_deadline_serves_lone_request():
+    """max_wait is a deadline, not a requirement: a single request is
+    served after at most one deadline, not held for a full bucket."""
+    model, _, _ = _model(max_wait_ms=50)
+    try:
+        t0 = time.perf_counter()
+        model.predict({"data": np.zeros(16, np.float32)}, timeout=60)
+        assert time.perf_counter() - t0 < 30   # generous vs 50 ms deadline
+        assert model.stats()["batches"] == 1
+    finally:
+        model.close()
+
+
+def test_submit_copies_caller_buffer():
+    """A client reusing ONE buffer across submits must not corrupt
+    queued requests — submit stages a private copy."""
+    model, sym, params = _model(max_wait_ms=300)
+    rng = RS(8)
+    a, b = rng.randn(2, 16).astype(np.float32)
+    buf = np.array(a)                      # matches dtype: asarray would alias
+    try:
+        f1 = model.submit({"data": buf})
+        buf[:] = b                         # mutate before the batch runs
+        f2 = model.submit({"data": buf})
+        r1, r2 = f1.result(60), f2.result(60)
+        ref = Predictor(sym, params, {"data": (2, 16)})
+        ref.forward(data=np.stack([a, b]))
+        want = ref.get_output(0)
+        np.testing.assert_array_equal(r1[0], want[0])   # still sample a
+        np.testing.assert_array_equal(r2[0], want[1])
+    finally:
+        model.close()
+
+
+def test_bucket_ladder_shares_one_weight_set():
+    """Every rung binds the SAME device-resident weight arrays — the
+    ladder costs one copy of the params, not one per bucket."""
+    model, _, _ = _model()
+    try:
+        model.warm()
+        w1 = model._predictors[1]._executor.arg_dict["fc1_weight"]
+        for b in model.buckets[1:]:
+            wb = model._predictors[b]._executor.arg_dict["fc1_weight"]
+            assert wb is w1                 # the identical NDArray object
+    finally:
+        model.close()
+
+
+def test_warm_compiles_whole_ladder():
+    model, _, _ = _model()
+    try:
+        assert model._predictors == {}
+        model.warm()
+        assert sorted(model._predictors) == model.buckets
+        # warmed model serves correctly
+        out = model.predict({"data": np.ones(16, np.float32)}, timeout=60)
+        assert out[0].shape == (4,)
+    finally:
+        model.close()
+
+
+def test_forward_error_scatters_to_every_future():
+    model, _, _ = _model(max_wait_ms=200)
+    try:
+        def boom(bucket):
+            raise RuntimeError("bucket exploded")
+        model._predictor = boom                     # instance-level override
+        futs = [model.submit({"data": np.zeros(16, np.float32)})
+                for _ in range(3)]
+        for f in futs:
+            with pytest.raises(RuntimeError, match="bucket exploded"):
+                f.result(60)
+        assert model.stats()["errors"] == 3
+        del model._predictor                        # restore class method
+        out = model.predict({"data": np.zeros(16, np.float32)}, timeout=60)
+        assert out[0].shape == (4,)                 # batcher survived
+    finally:
+        model.close()
+
+
+# -------------------------------------------------------------- multi-model
+def test_server_multi_model_hosting():
+    srv = serving.Server()
+    sym, params = _mlp()
+    sym2, params2 = _mlp(num_classes=7, seed=5)
+    try:
+        srv.register("a", symbol=sym, param_blob=params,
+                     input_shapes={"data": (16,)}, max_wait_ms=1)
+        srv.register("b", symbol=sym2, param_blob=params2,
+                     input_shapes={"data": (16,)}, max_wait_ms=1)
+        x = RS(4).randn(16).astype(np.float32)
+        assert srv.predict("a", {"data": x})[0].shape == (4,)
+        assert srv.predict("b", {"data": x})[0].shape == (7,)
+        stats = srv.models()
+        assert sorted(stats) == ["a", "b"]
+        assert stats["a"]["requests"] == 1 and stats["b"]["requests"] == 1
+        with pytest.raises(MXNetError, match="no model"):
+            srv.predict("c", {"data": x})
+        srv.unregister("a")
+        assert sorted(srv.models()) == ["b"]
+        srv.unregister("a")   # absent: no-op
+        with pytest.raises(MXNetError, match="ServedModel"):
+            srv.register("bad", model=object())
+        # registering a prebuilt model adopts the registry name (routes,
+        # telemetry tags, and thread name must agree) and rejects kwargs
+        pre = serving.ServedModel(sym, params, {"data": (16,)},
+                                  max_wait_ms=1)
+        assert srv.register("prod", model=pre) is pre
+        assert pre.name == "prod"
+        with pytest.raises(MXNetError, match="no build kwargs"):
+            srv.register("prod2", model=pre, max_batch=4)
+    finally:
+        srv.close()
+    assert srv.models() == {}
+
+
+def test_register_checkpoint_serves_trained_model(tmp_path):
+    rng = RS(0)
+    x = rng.randn(60, 16).astype(np.float32)
+    y = rng.randint(0, 4, 60).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=20)
+    from mxnet_tpu import models
+    mod = mx.Module(models.get_mlp(num_classes=4), context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "served")
+    mod.save_checkpoint(prefix, 2)
+
+    srv = serving.Server()
+    try:
+        srv.register_checkpoint("mlp", prefix, 2, {"data": (16,)},
+                                max_wait_ms=1)
+        out = srv.predict("mlp", {"data": x[0]})
+        it2 = mx.io.NDArrayIter(x[:1], y[:1], batch_size=1)
+        want = mod.predict(it2).asnumpy()[0]
+        np.testing.assert_allclose(out[0], want, rtol=1e-5, atol=1e-6)
+    finally:
+        srv.close()
+
+
+# --------------------------------------------------------------------- HTTP
+def _post(url, doc):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req).read())
+
+
+def test_http_front_end(tmp_path):
+    srv = serving.Server()
+    sym, params = _mlp()
+    srv.register("mlp", symbol=sym, param_blob=params,
+                 input_shapes={"data": (16,)}, max_wait_ms=1)
+    port = serving.start_server(port=0, registry=srv)
+    base = "http://127.0.0.1:%d" % port
+    try:
+        assert serving.server_port() == port
+        assert serving.start_server(port=0, registry=srv) == port  # idempotent
+
+        health = json.loads(urllib.request.urlopen(base + "/healthz").read())
+        assert health == {"ok": True, "models": ["mlp"]}
+        models = json.loads(urllib.request.urlopen(base + "/models").read())
+        assert models["models"]["mlp"]["inputs"] == {"data": [16]}
+
+        x = RS(5).randn(16).astype(np.float32)
+        doc = _post(base + "/predict/mlp", {"inputs": {"data": x.tolist()}})
+        want = srv.predict("mlp", {"data": x})[0]
+        np.testing.assert_array_equal(
+            np.asarray(doc["outputs"][0], np.float32), want)
+        # shorthand body: the top-level object IS the inputs dict, and
+        # the envelope's own timeout_s key doesn't pollute the inputs
+        doc2 = _post(base + "/predict/mlp",
+                     {"data": x.tolist(), "timeout_s": 30})
+        assert doc2["outputs"] == doc["outputs"]
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base + "/predict/nope", {"data": x.tolist()})
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base + "/predict/mlp", {"inputs": {"data": [0.0]}})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/nope")
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base + "/predict/mlp", ["not", "an", "object"])
+        assert e.value.code == 400
+        # TypeError-shaped request faults are 400 too, not a dropped
+        # connection: null timeout_s / non-numeric nested input
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base + "/predict/mlp",
+                  {"inputs": {"data": x.tolist()}, "timeout_s": None})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base + "/predict/mlp", {"inputs": {"data": {"a": 1}}})
+        assert e.value.code == 400
+
+        # non-finite outputs stay RFC-8259 parseable (stringified, the
+        # metrics_server convention) — the NaN incident must be readable
+        nan_params = {k: mx.nd.array(np.full(v.shape, np.nan, np.float32))
+                      for k, v in params.items()}
+        srv.register("nan", symbol=sym, param_blob=nan_params,
+                     input_shapes={"data": (16,)}, max_wait_ms=1)
+        doc3 = _post(base + "/predict/nan", {"inputs": {"data": x.tolist()}})
+        assert doc3["outputs"][0][0] == "nan"
+
+        # a forward failure scatters a raw exception -> 500 JSON, not a
+        # dropped connection; a scattered MXNetError is ALSO a server
+        # fault (failed bind/forward), not a 400 request fault
+        model = srv.model("mlp")
+        for exc in (RuntimeError("forward exploded"),
+                    MXNetError("bind exploded")):
+            model._predictor = (lambda err: lambda b: (_ for _ in ())
+                                .throw(err))(exc)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(base + "/predict/mlp",
+                      {"inputs": {"data": x.tolist()}})
+            assert e.value.code == 500
+            assert str(exc) in json.loads(e.value.read())["error"]
+        del model._predictor
+    finally:
+        serving.stop_server()
+        srv.close()
+    assert serving.server_port() is None
+    serving.stop_server()   # idempotent
+
+
+def test_http_concurrent_clients_coalesce():
+    """Concurrent HTTP posts ride the ThreadingHTTPServer's per-request
+    threads into the batcher — the server-side stats must show at least
+    one coalesced (n > 1) forward and every client its correct row."""
+    srv = serving.Server()
+    sym, params = _mlp()
+    model = srv.register("mlp", symbol=sym, param_blob=params,
+                         input_shapes={"data": (16,)}, max_batch=8,
+                         max_wait_ms=100)
+    model.warm()
+    port = serving.start_server(port=0, registry=srv)
+    base = "http://127.0.0.1:%d" % port
+    x = RS(6).randn(8, 16).astype(np.float32)
+    results = [None] * 8
+    try:
+        def client(i):
+            doc = _post(base + "/predict/mlp",
+                        {"inputs": {"data": x[i].tolist()}})
+            results[i] = np.asarray(doc["outputs"][0], np.float32)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        st = model.stats()
+        assert st["requests"] == 8
+        assert st["batches"] < 8            # something coalesced
+        ref = Predictor(sym, params, {"data": (8, 16)})
+        ref.forward(data=x)
+        want = ref.get_output(0)
+        for i in range(8):
+            # rows from any gemm-path bucket are bitwise stable, so every
+            # coalescing outcome matches the full-batch reference rows
+            # unless a client was served alone (bucket-1 matvec program);
+            # allow that one program boundary the last-ulp tolerance
+            np.testing.assert_allclose(results[i], want[i],
+                                       rtol=1e-6, atol=1e-7)
+    finally:
+        serving.stop_server()
+        srv.close()
+
+
+# ---------------------------------------------------------------- telemetry
+def test_serving_telemetry_signals():
+    tel.reset()
+    tel.start()
+    try:
+        model, _, _ = _model(max_wait_ms=300)
+        x = RS(7).randn(3, 16).astype(np.float32)
+        futs = [model.submit({"data": x[i]}) for i in range(3)]
+        for f in futs:
+            f.result(60)
+        model.predict({"data": x[0]}, timeout=60)   # lone request
+        model.close()
+        counters = tel.counters()
+        assert counters["serve_requests"] == 4
+        assert counters["serve_padded_slots"] == 1      # 3 -> bucket 4
+        hists = tel.histograms()
+        assert hists["serve.batch"]["count"] == 2
+        assert hists["serve.queue_wait"]["count"] == 4
+        assert tel.quantile("serve.batch", 0.99) is not None
+        gauges = tel.gauges()
+        assert gauges["serve_batch_size"] == 1          # last tick was lone
+        assert "serve_queue_depth" in gauges
+        # the per-bucket Predictor spans keep flowing underneath
+        assert hists["predict.forward"]["count"] == 2
+    finally:
+        tel.stop()
+        tel.reset()
+
+
+def test_serving_strict_noop_while_telemetry_disabled():
+    assert not tel.enabled()
+    model, _, _ = _model(max_wait_ms=1)
+    try:
+        model.predict({"data": np.zeros(16, np.float32)}, timeout=60)
+    finally:
+        model.close()
+    assert tel.counters() == {}
+    assert tel.events() == []
+    assert tel.histograms() == {}
+
+
+def test_serving_metrics_visible_on_metrics_endpoint():
+    """serve.* spans/counters flow into the PR 4 live endpoint for free."""
+    from mxnet_tpu import metrics_server
+    tel.reset()
+    tel.start()
+    try:
+        model, _, _ = _model(max_wait_ms=1)
+        model.predict({"data": np.zeros(16, np.float32)}, timeout=60)
+        model.close()
+        text = metrics_server.prometheus_text()
+        assert "mxtpu_serve_requests_total" in text
+        assert "mxtpu_serve_batch_bucket" in text
+        snap = metrics_server.json_snapshot()
+        assert snap["counters"]["serve_requests"] == 1
+        assert "serve.batch" in snap["histograms"]
+    finally:
+        tel.stop()
+        tel.reset()
+
+
+# ------------------------------------------------------------ perf + gating
+def test_bench_serving_record_and_run_compare_gate(tmp_path):
+    """The BENCH serving record passes ``run_compare --check`` against
+    itself, and a degraded run (qps down, p99 up) is flagged REGRESSION."""
+    import bench
+    from tools import run_compare
+
+    rec = bench.bench_serving(n_clients=4, requests_per_client=5,
+                              max_batch=4, dim=32, hidden=64, classes=8)
+    for key in ("serve_qps", "serve_p50_ms", "serve_p99_ms",
+                "serve_speedup"):
+        assert isinstance(rec[key], float) and rec[key] > 0, (key, rec)
+    assert rec["config"]["requests"] == 20
+    # context, not gated metrics: the noise-sensitive serial baseline and
+    # the occupancy ratio ride config
+    assert rec["config"]["serve_qps_serial"] > 0
+    assert 0 < rec["config"]["serve_batch_occupancy"] <= 1
+
+    bench_doc = {"metric": "resnet50_train_img_per_sec_b32", "value": 100.0,
+                 "unit": "img/s", "serving": rec}
+    a = tmp_path / "BENCH_a.json"
+    b = tmp_path / "BENCH_b.json"
+    a.write_text(json.dumps(bench_doc))
+    b.write_text(json.dumps(bench_doc))
+    assert run_compare.main([str(a), str(b), "--check"]) == 0
+
+    worse = json.loads(json.dumps(bench_doc))
+    worse["serving"]["serve_qps"] = rec["serve_qps"] * 0.5
+    worse["serving"]["serve_p99_ms"] = rec["serve_p99_ms"] * 3.0
+    b.write_text(json.dumps(worse))
+    assert run_compare.main([str(a), str(b), "--check"]) == 2
+
+    # machine view names both regressed serving metrics
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        run_compare.main([str(a), str(b), "--json"])
+    doc = json.loads(buf.getvalue())
+    flagged = set(doc["runs"][0]["regressions"])
+    assert {"serve_qps", "serve_p99_ms"} <= flagged
+    # config identity (clients, max_batch, serial baseline, occupancy)
+    # is NOT a gated metric
+    gated = {m["metric"] for m in doc["runs"][0]["metrics"]}
+    assert not gated & {"clients", "max_batch", "requests", "wait_ms",
+                        "serve_qps_serial", "serve_batch_occupancy"}
+
+
+def test_run_compare_serving_direction_hints():
+    from tools import run_compare
+    assert run_compare.direction_of("serve_qps") == "up"
+    assert run_compare.direction_of("serve_speedup") == "up"
+    assert run_compare.direction_of("serve_p50_ms") == "down"
+    assert run_compare.direction_of("serve_p99_ms") == "down"
+
+
+@pytest.mark.slow
+def test_batched_server_sustains_3x_serialized_throughput():
+    """Acceptance: under synthetic concurrent load on the CPU harness the
+    batched server sustains >= 3x the serialized one-at-a-time baseline
+    at equal request count.  Two attempts guard against a noisy-neighbor
+    first run (the compile is already outside bench_serving's clock)."""
+    import bench
+    best = 0.0
+    for _ in range(2):
+        rec = bench.bench_serving(n_clients=24, requests_per_client=30)
+        best = max(best, rec["serve_speedup"])
+        if best >= 3.0:
+            break
+    assert best >= 3.0, "batched/serialized speedup %.2fx < 3x" % best

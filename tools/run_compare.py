@@ -43,9 +43,10 @@ import sys
 
 # better-direction heuristics, matched against the series base name
 # (lowercased, tags stripped).  Directionless names are context only.
-_UP_HINTS = ("acc", "f1", "per_sec", "throughput", "reward", "top")
+_UP_HINTS = ("acc", "f1", "per_sec", "throughput", "reward", "top",
+             "qps", "speedup")
 _DOWN_HINTS = ("loss", "entropy", "err", "perplexity", "mae", "mse",
-               "rmse", "time", "wait")
+               "rmse", "time", "wait", "p50", "p90", "p99", "latency")
 
 _EVENT_TYPES = ("scalar", "span", "counter", "gauge", "hist", "summary")
 
@@ -127,6 +128,15 @@ def _load_bench(run, doc, path):
     if isinstance(rec, dict) and "metric" in rec and "value" in rec:
         run.bench[str(rec["metric"])] = float(rec["value"])
         run.meta = rec.get("meta")
+    # serving record (bench.py bench_serving): every numeric field is a
+    # gated headline metric (serve_qps up, serve_p50_ms/serve_p99_ms
+    # down via the direction hints); nested config blocks are identity,
+    # not metrics, and stay out of the comparison
+    serving = rec.get("serving") if isinstance(rec, dict) else None
+    if isinstance(serving, dict):
+        for k, v in serving.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                run.bench[str(k)] = float(v)
     chained = (run.meta or {}).get("telemetry_scalars")
     if chained:
         for candidate in (chained,
