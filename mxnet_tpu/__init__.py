@@ -8,6 +8,7 @@ from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context
 from . import base
 from . import telemetry
+from . import sanitize
 from . import metrics_server
 from . import diagnostics
 from . import ndarray

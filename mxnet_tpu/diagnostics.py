@@ -394,11 +394,13 @@ def check_outputs(outputs, mode, where="loss", **ctx):
     ``nonfinite_loss`` telemetry counter and warns/raises per ``mode``.
     Returns True when everything is finite.  Costs one device sync per
     output — the sentinel is opt-in precisely because of this."""
+    from . import sanitize as _san
     bad = {}
-    for i, o in enumerate(outputs):
-        n = _nonfinite_count(o)
-        if n:
-            bad[i] = n
+    with _san.allow_sync("check_numerics sentinel"):
+        for i, o in enumerate(outputs):
+            n = _nonfinite_count(o)
+            if n:
+                bad[i] = n
     if not bad:
         return True
     total = sum(bad.values())

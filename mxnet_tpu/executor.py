@@ -30,13 +30,9 @@ from .context import Context, current_context
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import random as _random
+from . import sanitize as _san
 
 __all__ = ["Executor"]
-
-# process-wide count of jit-compiled programs across ALL executors — a
-# per-executor gauge would overwrite itself last-writer-wins (bucketing
-# modules hold one executor per bucket)
-_jit_cache_total = 0
 
 
 def _node_uid(node, uid_map):
@@ -586,6 +582,16 @@ class Executor(object):
                 nd.zeros(s if s else (1,), ctx=self._ctx,
                          dtype=t if t is not None else _np.float32))
         self._jit_cache = {}
+        # mxsan RECOMPILE instrumentation + jit_cache_size gauge source:
+        # every executor's per-instance cache is visible to the registry
+        # (weakref-owned, so dead executors drop out of the gauge)
+        self._san_cache = _san.register_cache(
+            "executor", kind="executor", owner=self,
+            sizer=lambda ex: len(ex._jit_cache),
+            # _get_jit's inner jitted bodies (collision-proof names: the
+            # raw-jit watcher exempts these process-wide)
+            jit_names=("mxtpu_fwd", "mxtpu_grad", "mxtpu_walk_fwd",
+                       "mxtpu_walk_grad"))
         self._monitor_cb = None
         self._pullback = None
         self._warned_default_heads = False
@@ -717,15 +723,14 @@ class Executor(object):
         # toggling MXNET_BACKWARD_DO_MIRROR after an OOM must take effect
         mirror_key = (get_env("MXNET_BACKWARD_DO_MIRROR", "0"),
                       get_env("MXNET_BACKWARD_MIRROR_POLICY", ""))
-        cache_key = (kind,
-                     None if seq_mesh is None else
-                     (mesh_mod.mesh_cache_key(seq_mesh), seq_axis),
-                     mirror_key,
-                     # every env flag _Lowered.run consults while tracing
-                     # (layout/fusion passes, op A/B levers) — one shared
-                     # registry, base.TRACE_ENV_DEFAULTS, so a new lever
-                     # can't forget to key the cache
-                     trace_env_key())
+        seq_key = None if seq_mesh is None else \
+            (mesh_mod.mesh_cache_key(seq_mesh), seq_axis)
+        # every env flag _Lowered.run consults while tracing
+        # (layout/fusion passes, op A/B levers) — one shared registry,
+        # base.TRACE_ENV_DEFAULTS, so a new lever can't forget to key
+        # the cache
+        env_key = trace_env_key()
+        cache_key = (kind, seq_key, mirror_key, env_key)
         from . import telemetry as _tel
         fn = self._jit_cache.get(cache_key)
         if fn is not None:
@@ -751,6 +756,7 @@ class Executor(object):
                     merged.update(gargs)
                     o, aux_upd = self._walk(merged, aux, rng, True, False)
                     return tuple(o), aux_upd
+                f.__name__ = "mxtpu_walk_grad"
                 fn = jax.jit(f)
             else:
                 is_train = kind == "walk_fwd_train"
@@ -758,12 +764,17 @@ class Executor(object):
                 def fwd(args, aux, rng):
                     o, aux_upd = self._walk(args, aux, rng, is_train, False)
                     return tuple(o), aux_upd
+                fwd.__name__ = "mxtpu_walk_fwd"
                 fn = jax.jit(fwd)
         elif kind.startswith("fwd"):
             is_train = kind.startswith("fwd_train")
 
             def fwd(args, aux, rng):
                 return low.run(args, aux, rng, is_train, collect=collect)
+            # collision-proof program name: mxsan's raw-jit watcher
+            # exempts this cache's inner names process-wide, so a bare
+            # 'fwd'/'f' would also blind it to same-named user functions
+            fwd.__name__ = "mxtpu_fwd"
             fn = jax.jit(fwd)
         else:
             # Differentiated forward: jax.vjp over this jitted function runs
@@ -778,6 +789,7 @@ class Executor(object):
                 outs, aux_upd = res[0], res[1]
                 coll = res[2] if collect else {}
                 return tuple(outs), (aux_upd, coll)
+            f.__name__ = "mxtpu_grad"
             from .base import get_env
             if get_env("MXNET_BACKWARD_DO_MIRROR", "0") == "1":
                 # gradient mirroring -> rematerialisation: drop (some)
@@ -797,10 +809,12 @@ class Executor(object):
             # step breakdown instead of hiding inside `forward`
             fn = self._timed_first_call(cache_key, fn, kind)
         self._jit_cache[cache_key] = fn
-        if _tel._enabled:
-            global _jit_cache_total
-            _jit_cache_total += 1
-            _tel.gauge("jit_cache_size", _jit_cache_total)
+        # named key fields make mxsan's RECOMPILE diff readable (built
+        # from the SAME locals as cache_key, so key and report can never
+        # diverge); the call also refreshes the registry-sourced
+        # jit_cache_size gauge
+        self._san_cache.miss({"kind": kind, "seq_mesh": seq_key,
+                              "mirror": mirror_key, "trace_env": env_key})
         return fn
 
     def _timed_first_call(self, cache_key, fn, kind):
@@ -883,7 +897,8 @@ class Executor(object):
         from . import profiler as _profiler
         from . import telemetry as _tel
         mode = "train" if is_train else "test"
-        with _profiler.Scope("executor.forward[%s]" % mode, "symbolic"):
+        with _profiler.Scope("executor.forward[%s]" % mode, "symbolic"), \
+                _san.hot_region("executor.forward"):
             if not _tel._enabled:
                 return self._forward_impl(is_train, **kwargs)
             # jit="miss" on the span marks the call that paid trace+compile;
@@ -940,8 +955,12 @@ class Executor(object):
             for name, v in aux_upd.items():
                 if name in self.aux_dict:
                     self.aux_dict[name]._set_value(v)
-        for name, val in collected.items():
-            self._monitor_cb(name, NDArray(val))
+        if collected:
+            # monitor collection is an opt-in diagnostic — its callback
+            # may sync freely (mxsan: a planned transfer, not a finding)
+            with _san.allow_sync("monitor collection"):
+                for name, val in collected.items():
+                    self._monitor_cb(name, NDArray(val))
         from . import engine as _engine
         from . import profiler as _profiler
         from . import telemetry as _tel
@@ -949,7 +968,8 @@ class Executor(object):
             # sync so errors surface here (NaiveEngine) and the profiler/
             # telemetry spans reflect device time, not dispatch time
             import jax as _jax
-            _jax.block_until_ready(outs)
+            with _san.allow_sync("telemetry/naive-engine device sync"):
+                _jax.block_until_ready(outs)
         return self._output_nds
 
     def backward(self, out_grads=None):
@@ -960,7 +980,8 @@ class Executor(object):
         the forward's residuals, whether out_grads is implicit or explicit."""
         from . import profiler as _profiler
         from . import telemetry as _tel
-        with _profiler.Scope("executor.backward", "symbolic"):
+        with _profiler.Scope("executor.backward", "symbolic"), \
+                _san.hot_region("executor.backward"):
             if not _tel._enabled:
                 return self._backward_impl(out_grads)
             with _tel.span("executor.backward", cat="executor",
@@ -1020,7 +1041,8 @@ class Executor(object):
         from . import telemetry as _tel
         if _engine.is_naive() or _profiler.is_running() or _tel._enabled:
             import jax as _jax
-            _jax.block_until_ready([g for g in grads.values()])
+            with _san.allow_sync("telemetry/naive-engine device sync"):
+                _jax.block_until_ready([g for g in grads.values()])
 
     def _forward_eager(self, is_train, rng, monitor=False):
         """Eager multi-device walk for group2ctx model parallelism: every op runs

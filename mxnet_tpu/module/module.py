@@ -458,7 +458,37 @@ class Module(BaseModule):
         try:
             return _FusedFit(self, policy)
         except MXNetError as e:
+            from .. import sanitize as _san
+            if isinstance(e, _san.SanitizerError):
+                raise   # a sanitizer contract violation in :raise mode is
+                        # a finding, not a reason to fall back silently
             return fallback(str(e))
+
+
+def _fused_fit_key_fields(opt, policy):
+    """Named fields of the fused-fit TrainStep cache key.
+
+    num_update/begin_num_update are STEP STATE, not optimizer config —
+    they advance during training, and keying on them forced a full
+    recompile on every fit() after the first (the PR-7 bug; the counters
+    are re-imported into the TrainStep separately).  The trace-env levers
+    ARE part of the key (CKEY001): the step traces executor._Lowered.run,
+    so toggling e.g. MXNET_STEM_FUSE between fit() calls must land on a
+    fresh compile, exactly like toggling MXNET_AMP.  mxsan's RECOMPILE
+    checker watches this cache through these named fields — a seeded
+    regression (step state re-entering the key) is named field-by-field."""
+    from ..base import trace_env_key
+    return {
+        "optimizer": type(opt).__name__,
+        "opt_hyper": tuple(sorted((k, v) for k, v in vars(opt).items()
+                                  if isinstance(v, (int, float, bool, str))
+                                  and k not in ("num_update",
+                                                "begin_num_update"))),
+        "lr_mult": tuple(sorted(getattr(opt, "lr_mult", {}).items())),
+        "wd_mult": tuple(sorted(getattr(opt, "wd_mult", {}).items())),
+        "policy": policy.key() if policy is not None else None,
+        "trace_env": trace_env_key(),
+    }
 
 
 class _FusedFit(object):
@@ -466,29 +496,23 @@ class _FusedFit(object):
 
     def __init__(self, module, policy=None):
         import jax
+        from .. import sanitize as _san
         from ..train import TrainStep
         self._mod = module
         self._policy = policy
-        # one XLA program per (optimizer config, precision policy): cache
-        # the compiled TrainStep on the module — each fit() re-creates the
-        # optimizer, and rebuilding the step would recompile every call.
-        # The policy is PART of the key: toggling MXNET_AMP between fit()
-        # calls must land on a fresh compile, not silently reuse the
-        # program compiled under the old precision (mxlint JIT001's
-        # stale-cache hazard, at the TrainStep-cache level)
+        # one XLA program per (optimizer config, precision policy,
+        # trace-env snapshot): cache the compiled TrainStep on the module
+        # — each fit() re-creates the optimizer, and rebuilding the step
+        # would recompile every call.
         opt = module._optimizer
-        # num_update/begin_num_update are STEP STATE, not optimizer config
-        # — they advance during training, and keying on them forced a full
-        # recompile on every fit() after the first (the counters are
-        # re-imported into the TrainStep separately)
-        key = (type(opt).__name__,
-               tuple(sorted((k, v) for k, v in vars(opt).items()
-                            if isinstance(v, (int, float, bool, str))
-                            and k not in ("num_update",
-                                          "begin_num_update"))),
-               tuple(sorted(getattr(opt, "lr_mult", {}).items())),
-               tuple(sorted(getattr(opt, "wd_mult", {}).items())),
-               policy.key() if policy is not None else None)
+        fields = _fused_fit_key_fields(opt, policy)
+        key = tuple(sorted(fields.items()))
+        san = getattr(module, "_san_fused_cache", None)
+        if san is None:
+            san = module._san_fused_cache = _san.register_cache(
+                "fused_fit", kind="fused_fit", owner=module,
+                sizer=lambda m: 1 if getattr(m, "_fused_ts_cache", None)
+                else 0)
         cached = getattr(module, "_fused_ts_cache", None)
         if cached is not None and cached[0] == key:
             self._ts = cached[1]
@@ -501,6 +525,7 @@ class _FusedFit(object):
                                  label_names=tuple(module._label_names),
                                  policy=policy)
             module._fused_ts_cache = (key, self._ts)
+            san.miss(fields)
         # the fit loop runs its own sentinel with epoch/nbatch context —
         # a step-level raise would hide the batch index
         self._ts.check_numerics = False

@@ -324,6 +324,14 @@ def attr_key(attrs):
 # ------------------------------------------------------------- imperative JIT
 _JIT_CACHE = {}
 
+# mxsan RECOMPILE instrumentation + jit_cache_size gauge source for the
+# imperative dispatch cache (one entry per (op, resolved attrs, is_train,
+# sequence mesh))
+from .. import sanitize as _san  # noqa: E402 — after _JIT_CACHE exists
+
+_SAN_CACHE = _san.register_cache("ops.registry", kind="op",
+                                 sizer=lambda: len(_JIT_CACHE))
+
 
 def jitted(op, attrs, is_train=False):
     """Return the jit-compiled callable for (op, attrs, is_train)."""
@@ -344,6 +352,8 @@ def jitted(op, attrs, is_train=False):
     if fn is None:
         fn = jax.jit(op.make_callable(attrs, is_train))
         _JIT_CACHE[key] = fn
+        _SAN_CACHE.miss({"op": op.name, "attrs": attr_key(attrs),
+                         "is_train": bool(is_train), "seq_mesh": seq_key})
     return fn
 
 

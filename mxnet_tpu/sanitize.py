@@ -1,0 +1,774 @@
+"""mxsan — opt-in runtime sanitizer for the invariants mxlint can only
+check statically.
+
+The repo's perf story rests on two runtime contracts: every jit cache
+stays *warm* in steady state (a recompile is seconds of silent stall —
+the PR-7 fused-fit cache keyed on ``num_update`` and recompiled on every
+``fit()`` after the first), and the hot path never syncs to host unless
+an observability lever asked for it.  mxlint's JIT001/SYNC001 police the
+source; this module polices the *running process* — the dynamic twin,
+the way ``test_import_noop.py`` is NOOP001's dynamic twin.
+
+Arm with ``MXNET_SAN=recompile,sync,donate`` (any subset; append
+``:raise`` to fail fast instead of warning).  With the variable unset
+this module is a strict no-op: no thread, no hook, no patched function,
+no logging handler — every entry point degrades to one module-global
+bool check (the telemetry/diagnostics autostart discipline).
+
+Three checkers:
+
+* **RECOMPILE** — every jit cache in the repo registers itself through
+  :func:`register_cache` (the executor's per-instance ``_jit_cache``,
+  the imperative op cache ``ops/registry._JIT_CACHE``, the fused-fit
+  TrainStep cache, ``TrainStep._multi_cache``, ``serving.ServedModel``'s
+  bucket-rung ladder — and any future pp/elastic cache that merely
+  calls ``register_cache``).  Each cache-miss reports its key as a dict
+  of named fields; after a per-cache warmup budget (``MXNET_SAN_WARMUP``
+  overrides every budget; the per-cache defaults correspond to one
+  warmup epoch / one tick per serving rung) any further miss
+  warns-or-raises naming the cache, its kind tag, and a field diff of
+  the new key against its nearest warm neighbour — so the PR-7 class
+  surfaces as ``key differs in field(s): num_update (0 -> 50)`` instead
+  of a mysteriously slow second epoch.  Raw ``jax.jit`` sites outside
+  any registered cache are watched through jax's compile-logging hook
+  (a handler on the ``jax._src.interpreters.pxla`` logger): a function
+  name that keeps compiling past its budget is reported too (warn-only
+  — the logging layer swallows exceptions raised from handlers).
+
+* **SYNC** — SYNC001's dynamic twin.  The hot-path regions (the fused
+  TrainStep call, executor forward/backward, the serving batcher's
+  coalesced forward) run inside :func:`hot_region`, which arms jax's
+  ``transfer_guard_device_to_host`` (``disallow`` in raise mode,
+  ``log`` otherwise — the guard fires on real accelerator transfers)
+  plus Python-level sync hooks (``jax.device_get``,
+  ``jax.block_until_ready``, and the jax array's ``item``/``__float__``
+  /``__int__``/``__bool__``/``__array__`` — installed only while
+  armed, restored on :func:`disarm`).  An unplanned device->host sync
+  inside a region is a named violation; the legitimately-gated sites
+  (telemetry span timing, ``amp_stats``, the numerics sentinel, the
+  monitor) wrap themselves in :func:`allow_sync` with a reason, which
+  also counts how often the escape hatch was used.
+
+* **DONATE** — the donated-jit entry points (``TrainStep.__call__`` /
+  ``run_steps``: params, optimizer state, aux, the loss-scale state)
+  note every leaf they donate; passing such a buffer back into a step
+  (or reading it through a sync hook) is flagged as a named contract
+  violation — ``params['fc1_weight'] was donated at num_update=3`` —
+  BEFORE XLA's cryptic "buffer has been deleted or donated" crash, and
+  independently of whether the backend actually donated (a backend that
+  silently ignores donation would ship the bug latent until the first
+  run on one that honours it).
+
+``stats()`` / ``violations()`` expose counters and the recent violation
+messages; under telemetry every cache miss also refreshes the
+``jit_cache_size`` gauge from the registry (the sum of live entries
+across ALL registered caches — executor, imperative ops, fused-fit,
+serving rungs), replacing the old executor-only ever-growing counter.
+
+See docs/static_analysis.md "Runtime sanitizers".
+"""
+from __future__ import annotations
+
+import threading
+import warnings
+import weakref
+from collections import deque
+from contextlib import nullcontext
+
+from .base import MXNetError, get_env
+from . import telemetry as _tel
+
+__all__ = ["SanitizerError", "SanitizerWarning", "arm", "disarm", "armed",
+           "register_cache", "hot_region", "allow_sync", "note_donated",
+           "check_donated", "donated_entry", "total_cache_entries",
+           "caches", "stats", "violations", "reset"]
+
+CHECKERS = ("recompile", "sync", "donate")
+
+# per-kind default warmup budgets: the number of cache misses that count
+# as legitimate warmup (one epoch of compiles for the train-side caches,
+# one tick per rung for serving).  MXNET_SAN_WARMUP overrides all of
+# them with one integer.
+DEFAULT_WARMUPS = {
+    "executor": 16,       # jit kinds x mon variants x trace-env retraces
+    "op": 256,            # imperative dispatch: one key per (op, attrs)
+    "fused_fit": 1,       # one TrainStep per (optimizer, policy, env)
+    "train_multi": 4,     # run_steps chunk shapes
+    "serving-rung": 8,    # overridden per model with len(buckets)
+    "jax.jit": 16,        # raw-jit watcher: per function name
+}
+_WARM_KEEP = 512          # warm keys remembered per cache (FIFO)
+_WARN_QUOTA = 10          # per-cache warn cap (counters keep counting)
+
+
+class SanitizerError(MXNetError):
+    """A sanitizer contract violation in ``:raise`` mode."""
+
+
+class SanitizerWarning(UserWarning):
+    """A sanitizer contract violation in warn mode (the default)."""
+
+
+_lock = threading.RLock()
+_armed = frozenset()      # subset of CHECKERS
+_mode = "warn"
+# hot-path guards: one module-global bool read while disarmed
+_recompile_on = False
+_sync_on = False
+_donate_on = False
+
+_CACHES = []              # list[_CacheHandle]
+_DONATED = {}             # id(leaf) -> (label, where, step, ref)
+_RAW_COMPILES = {}        # (jit fun name, shapes signature) -> count
+# inner-function names registered caches jit (declared via
+# register_cache(jit_names=...)): their compiles are those caches' OWN
+# misses — the raw-jit watcher must not double-count them (many
+# executors re-binding the same shapes legitimately recompile 'fwd')
+_REGISTERED_JIT_NAMES = set()
+_stats = {"recompile_violations": 0, "sync_violations": 0,
+          "donate_violations": 0, "sync_allowed": 0, "cache_misses": 0,
+          "raw_compiles": 0}
+_violations = deque(maxlen=200)
+_tls = threading.local()
+_log_handler = None       # compile-log watcher state
+_log_prev_level = None
+_log_prev_propagate = None
+_patches = []             # (obj, attr, original) for sync/donate hooks
+
+
+# ----------------------------------------------------------------- helpers
+def _state():
+    st = getattr(_tls, "st", None)
+    if st is None:
+        st = _tls.st = type("_TlsState", (), {})()
+        st.regions = []
+        st.allow = 0
+    return st
+
+
+def _short(v, limit=64):
+    r = repr(v)
+    return r if len(r) <= limit else r[:limit - 3] + "..."
+
+
+def _violation(checker, message, raise_ok=True, quiet=False):
+    """Record one violation; warn or raise per the armed mode.  ``quiet``
+    suppresses the warning (counters and the violation log still record —
+    used to cap per-cache warn spam)."""
+    with _lock:
+        _stats[checker + "_violations"] += 1
+        _violations.append(message)
+    if _tel._enabled:
+        _tel.counter("san_violations", checker=checker)
+    if _mode == "raise" and raise_ok:
+        raise SanitizerError(message)
+    if not quiet:
+        warnings.warn(message, SanitizerWarning, stacklevel=3)
+
+
+# ------------------------------------------------------------ cache registry
+class _CacheHandle(object):
+    """One registered jit cache: warm-key memory for the RECOMPILE
+    checker plus a live-entry sizer for the ``jit_cache_size`` gauge."""
+
+    def __init__(self, name, kind, owner, sizer, warmup, jit_names=()):
+        self.name = name
+        self.kind = kind or name
+        self.warmup = warmup
+        if jit_names:
+            with _lock:
+                _REGISTERED_JIT_NAMES.update(jit_names)
+        self._sizer = sizer
+        self._owner_ref = None
+        if owner is not None:
+            try:
+                self._owner_ref = weakref.ref(owner)
+            except TypeError:       # un-weakref-able owner: pin it
+                self._owner_ref = lambda o=owner: o
+        self._warm = deque(maxlen=_WARM_KEEP)
+        self._misses = 0
+        self._miss_anchor = 0       # miss count when the checker was armed
+        self._warned = 0
+
+    # -- registry plumbing
+    def alive(self):
+        return self._owner_ref is None or self._owner_ref() is not None
+
+    def entries(self):
+        if not self.alive():
+            return 0
+        try:
+            if self._owner_ref is not None:
+                return int(self._sizer(self._owner_ref()))
+            return int(self._sizer()) if self._sizer is not None else 0
+        except Exception:
+            return 0
+
+    def _budget(self):
+        env = get_env("MXNET_SAN_WARMUP", None, typ=int)
+        if env is not None:
+            return max(0, env)
+        return self.warmup if self.warmup is not None \
+            else DEFAULT_WARMUPS.get(self.kind, 16)
+
+    # -- the RECOMPILE entry point (call on every cache MISS; a miss is
+    #    about to pay an XLA compile, so the dict build costs nothing)
+    def miss(self, fields):
+        fields = dict(fields)
+        violation = None
+        with _lock:
+            self._misses += 1
+            _stats["cache_misses"] += 1
+            if _recompile_on and \
+                    (self._misses - self._miss_anchor) > self._budget():
+                violation = self._diff_message(fields)
+            else:
+                self._warm.append(fields)
+        if _tel._enabled:
+            _tel.gauge("jit_cache_size", total_cache_entries())
+        if violation is not None:
+            with _lock:
+                self._warned += 1
+                quiet = self._warned > _WARN_QUOTA
+            _violation("recompile", violation, quiet=quiet)
+
+    def _diff_message(self, fields):
+        head = ("mxsan RECOMPILE: jit cache '%s' (kind=%s) missed after "
+                "its warmup budget (%d)" % (self.name, self.kind,
+                                            self._budget()))
+        best, best_score = None, -1
+        for w in self._warm:
+            score = sum(1 for k in fields if k in w and w[k] == fields[k])
+            if score > best_score:
+                best, best_score = w, score
+        if best is None:
+            return head + " with no warm keys recorded — an always-cold " \
+                "cache on the hot path"
+        diffs = sorted(set(fields) | set(best))
+        parts = ["%s (%s -> %s)" % (k, _short(best.get(k)),
+                                    _short(fields.get(k)))
+                 for k in diffs if best.get(k) != fields.get(k)]
+        return head + "; key differs from its nearest warm neighbour in " \
+            "field(s): %s — an unstable cache key (step state or an " \
+            "unkeyed lever leaking into the key; the PR-7 num_update " \
+            "class)" % ("; ".join(parts) or "<none — duplicate key, "
+                        "entries are being evicted/rebuilt>")
+
+    def snapshot(self):
+        with _lock:
+            return {"name": self.name, "kind": self.kind,
+                    "entries": self.entries(), "misses": self._misses,
+                    "warm": len(self._warm), "warmup": self._budget()}
+
+
+def register_cache(name, kind=None, owner=None, sizer=None, warmup=None,
+                   jit_names=()):
+    """Register a jit cache with the sanitizer; returns a handle.
+
+    Call :meth:`handle.miss(fields)` on every cache miss with the key as
+    a dict of *named* fields (field names make the RECOMPILE diff
+    readable: ``num_update (0 -> 50)``).  ``sizer`` reports live entry
+    count — ``sizer(owner)`` when ``owner`` is given (held by weakref so
+    a dead owner drops out of the ``jit_cache_size`` gauge), else
+    ``sizer()``.  ``warmup`` is this cache's miss budget (default: the
+    per-``kind`` entry in ``DEFAULT_WARMUPS``; ``MXNET_SAN_WARMUP``
+    overrides every budget).  ``jit_names`` declares the inner function
+    names this cache jits (``("fwd", "f")`` for the executor): their
+    compiles are this cache's own misses, so the raw-jit log watcher
+    skips them.  Registration is always active and costs a list append —
+    the checkers consult it only when armed."""
+    h = _CacheHandle(name, kind, owner, sizer, warmup, jit_names=jit_names)
+    with _lock:
+        _CACHES.append(h)
+        if len(_CACHES) % 64 == 0:      # prune dead owners occasionally
+            _CACHES[:] = [c for c in _CACHES if c.alive()]
+    return h
+
+
+def total_cache_entries():
+    """Live compiled-program count across every registered cache — the
+    ``jit_cache_size`` gauge source (executor kinds + imperative op keys
+    + fused-fit steps + serving rungs all visible)."""
+    with _lock:
+        handles = list(_CACHES)
+    return sum(h.entries() for h in handles if h.alive())
+
+
+def caches():
+    """Snapshot of every live registered cache (diagnostics/tests)."""
+    with _lock:
+        handles = list(_CACHES)
+    return [h.snapshot() for h in handles if h.alive()]
+
+
+# ------------------------------------------------------- raw-jit compile log
+_PXLA_LOGGER = "jax._src.interpreters.pxla"
+
+
+def _raw_compile(fun_name, shapes):
+    """One XLA compile seen through the log hook.  A *healthy* process
+    never compiles the same (function, shapes) signature twice — jax's
+    own pjit cache would have hit; repeats mean fresh jit objects are
+    being created for the same program (the PR-7 loop at the raw-jit
+    level).  Distinct shapes are normal warmup (buckets, rungs)."""
+    with _lock:
+        if len(_RAW_COMPILES) > 65536:       # runaway/shape-churn guard
+            _RAW_COMPILES.clear()
+        key = (fun_name, shapes)
+        _RAW_COMPILES[key] = n = _RAW_COMPILES.get(key, 0) + 1
+        _stats["raw_compiles"] += 1
+    env = get_env("MXNET_SAN_WARMUP", None, typ=int)
+    budget = max(0, env) if env is not None else DEFAULT_WARMUPS["jax.jit"]
+    if n > budget:
+        # raise_ok=False: logging swallows exceptions raised from
+        # handlers, so the raw-jit watcher always warns (and counts);
+        # quiet past the per-signature quota, mirroring the per-cache cap
+        _violation(
+            "recompile",
+            "mxsan RECOMPILE: raw jax.jit '%s' compiled %d times (budget "
+            "%d) for the SAME input signature %s — an unstable cache key "
+            "or an untracked jit site; route it through a cache "
+            "registered with sanitize.register_cache"
+            % (fun_name, n, budget, _short(shapes, 96)),
+            raise_ok=False, quiet=(n - budget) > _WARN_QUOTA)
+
+
+def _make_log_handler():
+    import logging
+    import re
+    pat = re.compile(
+        r"^Compiling (\S+) with global shapes and types (\[.*?\])\.")
+
+    class _CompileLogHandler(logging.Handler):
+        def emit(self, record):
+            try:
+                m = pat.match(record.getMessage())
+            except Exception:       # never break the observed process
+                return
+            # zero-arg programs are jax's own trace-time constant
+            # subroutines (jit('call') churn while tracing) — not a
+            # recompile-loop signal; names a registered cache declared
+            # (via jit_names=) are that cache's own misses, watched by
+            # its handle with its own warmup budget
+            if m and m.group(2) != "[]" \
+                    and m.group(1) not in _REGISTERED_JIT_NAMES:
+                _raw_compile(m.group(1), m.group(2))
+
+    return _CompileLogHandler(level=logging.DEBUG)
+
+
+def _attach_compile_log():
+    global _log_handler, _log_prev_level, _log_prev_propagate
+    import logging
+    logger = logging.getLogger(_PXLA_LOGGER)
+    _log_handler = _make_log_handler()
+    _log_prev_level = logger.level
+    _log_prev_propagate = logger.propagate
+    logger.addHandler(_log_handler)
+    # the "Compiling <fun>" line logs at DEBUG unless jax_log_compiles is
+    # on, so the logger's level must drop to DEBUG — and propagation must
+    # stop, or every compile line would spill to stderr through the
+    # handler jax installs on its parent "jax" logger.  Both are restored
+    # exactly on disarm.
+    logger.propagate = False
+    if logger.getEffectiveLevel() > logging.DEBUG:
+        logger.setLevel(logging.DEBUG)
+
+
+def _detach_compile_log():
+    global _log_handler, _log_prev_level, _log_prev_propagate
+    if _log_handler is None:
+        return
+    import logging
+    logger = logging.getLogger(_PXLA_LOGGER)
+    logger.removeHandler(_log_handler)
+    logger.setLevel(_log_prev_level if _log_prev_level is not None
+                    else logging.NOTSET)
+    if _log_prev_propagate is not None:
+        logger.propagate = _log_prev_propagate
+    _log_handler = None
+    _log_prev_level = None
+    _log_prev_propagate = None
+
+
+# ------------------------------------------------------------- sync checker
+_NOOP = nullcontext()     # shared disabled-path singleton (reentrant)
+
+
+class _HotRegion(object):
+    """Armed hot-path region: transfer guard + thread-local region mark."""
+
+    __slots__ = ("name", "_tg")
+
+    def __init__(self, name):
+        self.name = name
+        self._tg = None
+
+    def __enter__(self):
+        import jax
+        self._tg = jax.transfer_guard_device_to_host(
+            "disallow" if _mode == "raise" else "log")
+        self._tg.__enter__()
+        # marked LAST: a failure above must not leave a stale region
+        # (the with-statement skips __exit__ when __enter__ raises)
+        _state().regions.append(self.name)
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            if self._tg is not None:
+                self._tg.__exit__(*exc)
+        finally:
+            st = _state()
+            if st.regions:
+                st.regions.pop()
+        return False
+
+
+def hot_region(name):
+    """Mark a hot-path region (fused TrainStep call, executor
+    forward/backward, the serving batcher's coalesced forward).  A no-op
+    singleton while the SYNC checker is off; armed, it enables jax's
+    device->host transfer guard and the Python sync hooks for the
+    dynamic extent of the ``with`` block."""
+    if not _sync_on:
+        return _NOOP
+    return _HotRegion(name)
+
+
+class _AllowSync(object):
+    """Scoped escape hatch for planned syncs inside a hot region."""
+
+    __slots__ = ("reason", "_tg")
+
+    def __init__(self, reason):
+        self.reason = reason
+        self._tg = None
+
+    def __enter__(self):
+        if _sync_on:
+            import jax
+            self._tg = jax.transfer_guard_device_to_host("allow")
+            self._tg.__enter__()
+        # incremented LAST: a failure above must not leak the allow count
+        # (the with-statement skips __exit__ when __enter__ raises, and a
+        # leaked increment would silently disable SYNC on this thread)
+        _state().allow += 1
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            if self._tg is not None:
+                self._tg.__exit__(*exc)
+        finally:
+            _state().allow -= 1
+        return False
+
+
+def allow_sync(reason):
+    """Declare a *planned* device sync (telemetry span timing, the
+    numerics sentinel, monitor collection, ``amp_stats``): inside the
+    scope the SYNC checker stands down and counts the use instead of
+    flagging it.  No-op while the sanitizer is off."""
+    if not (_sync_on or _donate_on):
+        return _NOOP
+    return _AllowSync(reason)
+
+
+def _sync_event(what):
+    """A Python-level sync hook fired.  Free outside hot regions."""
+    st = _state()
+    if not st.regions:
+        return
+    if st.allow:
+        with _lock:
+            _stats["sync_allowed"] += 1
+        return
+    _violation("sync",
+               "mxsan SYNC: unplanned host sync (%s) inside hot region "
+               "'%s' — the telemetry-off step must not touch the host; "
+               "move it out of the per-step body or scope it with "
+               "sanitize.allow_sync(reason)" % (what, st.regions[-1]))
+
+
+# ----------------------------------------------------------- donate checker
+def _donated_cleanup(key):
+    def cb(_ref):
+        _DONATED.pop(key, None)
+    return cb
+
+
+def note_donated(where, labeled_leaves, step=None):
+    """Record buffers just donated to a jit (called AFTER dispatch by the
+    donating entry points).  ``labeled_leaves`` yields ``(label, leaf)``
+    pairs — the label names the pytree path in the violation message."""
+    for label, leaf in labeled_leaves:
+        if leaf is None or not hasattr(leaf, "dtype"):
+            continue
+        key = id(leaf)
+        try:
+            ref = weakref.ref(leaf, _donated_cleanup(key))
+        except TypeError:
+            ref = (lambda obj=leaf: obj)     # pin: id stays valid
+        with _lock:
+            _DONATED[key] = (label, where, step, ref)
+            if len(_DONATED) > 65536:        # runaway guard
+                _DONATED.clear()
+
+
+def donated_entry(leaf):
+    """(label, where, step) when ``leaf`` was donated earlier, else
+    None.  Identity-checked through the stored weakref so a recycled
+    ``id()`` can never mis-accuse a fresh array."""
+    ent = _DONATED.get(id(leaf))
+    if ent is None:
+        return None
+    label, where, step, ref = ent
+    if ref() is not leaf:
+        return None
+    return label, where, step
+
+
+def _deleted(leaf):
+    try:
+        return bool(leaf.is_deleted())
+    except Exception:
+        return False
+
+
+def check_donated(where, labeled_leaves):
+    """Flag any input buffer that an earlier step donated — the
+    delete-on-donate crash surfaced as a named contract violation before
+    the dispatch dies, and surfaced at all on backends that silently
+    ignore donation (where the stale-buffer bug would ship latent)."""
+    for label, leaf in labeled_leaves:
+        if leaf is None:
+            continue
+        ent = donated_entry(leaf)
+        if ent is not None:
+            dlabel, dwhere, dstep = ent
+            _violation(
+                "donate",
+                "mxsan DONATE: %s passed to %s was already donated (as %s "
+                "by %s%s) — donated buffers die with the jit call; thread "
+                "the step's RETURNED pytrees forward instead of re-using "
+                "the inputs" % (label, where, dlabel, dwhere,
+                                "" if dstep is None
+                                else " at num_update=%s" % dstep))
+        elif _deleted(leaf):
+            _violation(
+                "donate",
+                "mxsan DONATE: %s passed to %s refers to a deleted (XLA-"
+                "donated) buffer — thread the returned pytrees forward"
+                % (label, where))
+
+
+# -------------------------------------------------------------- sync hooks
+def _install_hooks():
+    """Patch the Python-level sync/read choke points.  Installed only on
+    arm, restored exactly on disarm; wrappers delegate unconditionally
+    when execution is outside a hot region."""
+    import jax
+
+    def _patch(obj, attr, make):
+        orig = getattr(obj, attr)
+        try:
+            setattr(obj, attr, make(orig))
+        except (AttributeError, TypeError):
+            return                   # unpatchable on this jax version
+        _patches.append((obj, attr, orig))
+
+    def _donate_guard(args):
+        if not _donate_on or not args:
+            return
+        a0 = args[0]
+        if hasattr(a0, "dtype"):
+            leaves = (a0,)
+        elif isinstance(a0, (dict, list, tuple)):
+            # device_get/block_until_ready take whole pytrees (the repo's
+            # own idiom passes dicts/lists) — check every leaf
+            import jax
+            leaves = jax.tree_util.tree_leaves(a0)
+        else:
+            return
+        for a in leaves:
+            ent = donated_entry(a) if hasattr(a, "dtype") else None
+            if ent is not None:
+                label, where, step = ent
+                _violation(
+                    "donate",
+                    "mxsan DONATE: read of donated buffer %s (donated by "
+                    "%s%s) — this raises XLA's 'Array has been deleted' "
+                    "on a real accelerator" % (
+                        label, where,
+                        "" if step is None else " at num_update=%s" % step))
+
+    def wrap_fn(what):
+        def make(orig):
+            def wrapper(*args, **kwargs):
+                _donate_guard(args[:1])
+                _sync_event(what)
+                return orig(*args, **kwargs)
+            wrapper.__name__ = getattr(orig, "__name__", what)
+            wrapper._mxsan_orig = orig
+            return wrapper
+        return make
+
+    def wrap_method(what):
+        def make(orig):
+            def wrapper(self, *args, **kwargs):
+                _donate_guard((self,))
+                _sync_event(what)
+                return orig(self, *args, **kwargs)
+            wrapper.__name__ = getattr(orig, "__name__", what)
+            wrapper._mxsan_orig = orig
+            return wrapper
+        return make
+
+    _patch(jax, "device_get", wrap_fn("jax.device_get"))
+    _patch(jax, "block_until_ready", wrap_fn("jax.block_until_ready"))
+    try:
+        from jax._src.array import ArrayImpl
+    except ImportError:
+        return
+    for attr, what in (("item", ".item()"), ("__float__", "float()"),
+                       ("__int__", "int()"), ("__bool__", "bool()"),
+                       ("__array__", "np.asarray()")):
+        _patch(ArrayImpl, attr, wrap_method(what))
+
+
+def _remove_hooks():
+    while _patches:
+        obj, attr, orig = _patches.pop()
+        try:
+            setattr(obj, attr, orig)
+        except (AttributeError, TypeError):
+            pass
+
+
+# -------------------------------------------------------------- arm/disarm
+def _parse_spec(raw):
+    raw = raw.strip()
+    mode = "warn"
+    if raw.endswith(":raise"):
+        mode, raw = "raise", raw[:-len(":raise")]
+    elif raw.endswith(":warn"):
+        raw = raw[:-len(":warn")]
+    checkers = set()
+    for tok in raw.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok == "all":
+            checkers.update(CHECKERS)
+        elif tok in CHECKERS:
+            checkers.add(tok)
+        else:
+            raise MXNetError(
+                "MXNET_SAN: unknown checker %r (want a comma list of %s, "
+                "optionally ending in ':raise')" % (tok, "/".join(CHECKERS)))
+    return checkers, mode
+
+
+def arm(checkers="all", mode=None):
+    """Arm the sanitizer.  ``checkers`` is an iterable or a comma string
+    (``"recompile,sync"``; may carry a trailing ``:raise``); ``mode`` is
+    ``"warn"`` (default) or ``"raise"``.  Idempotent per configuration;
+    warmup budgets count from the moment of arming."""
+    global _armed, _mode, _recompile_on, _sync_on, _donate_on
+    if isinstance(checkers, str):
+        parsed, spec_mode = _parse_spec(checkers)
+    else:
+        parsed, spec_mode = set(checkers), "warn"
+        bad = parsed - set(CHECKERS)
+        if bad:
+            raise MXNetError("MXNET_SAN: unknown checker(s) %s"
+                             % sorted(bad))
+    mode = mode or spec_mode
+    if mode not in ("warn", "raise"):
+        raise MXNetError("sanitize.arm: mode must be 'warn' or 'raise'")
+    # the handler/patch installs happen UNDER the lock too: concurrent
+    # arm() calls would otherwise double-install and disarm() would then
+    # leak one handler forever (none of the installs re-enter _lock)
+    with _lock:
+        disarm()
+        if not parsed:
+            return False
+        _armed = frozenset(parsed)
+        _mode = mode
+        _recompile_on = "recompile" in _armed
+        _sync_on = "sync" in _armed
+        _donate_on = "donate" in _armed
+        for h in _CACHES:
+            h._miss_anchor = h._misses      # budgets count from arming
+            h._warned = 0
+        if _recompile_on:
+            _attach_compile_log()
+        if _sync_on or _donate_on:
+            _install_hooks()
+    return True
+
+
+def disarm():
+    """Restore every patched function / handler and return to the
+    strict-no-op state.  Registered caches, their warm keys and the
+    stats survive (the registry also feeds the jit_cache_size gauge)."""
+    global _armed, _mode, _recompile_on, _sync_on, _donate_on
+    with _lock:
+        _armed = frozenset()
+        _recompile_on = _sync_on = _donate_on = False
+        _mode = "warn"
+        _detach_compile_log()
+        _remove_hooks()
+
+
+def armed():
+    """The armed checker set (empty frozenset when off)."""
+    return _armed
+
+
+def stats():
+    """Copy of the violation/usage counters."""
+    with _lock:
+        return dict(_stats)
+
+
+def violations():
+    """The most recent violation messages (bounded)."""
+    with _lock:
+        return list(_violations)
+
+
+def reset():
+    """Zero the stats, violation log, donated-buffer registry, raw-jit
+    counts and every cache's miss anchor (test isolation)."""
+    with _lock:
+        for k in _stats:
+            _stats[k] = 0
+        _violations.clear()
+        _DONATED.clear()
+        _RAW_COMPILES.clear()
+        for h in _CACHES:
+            h._miss_anchor = h._misses
+            h._warned = 0
+
+
+# ------------------------------------------------- autostart (env contract)
+def _autostart():
+    """``MXNET_SAN=recompile,sync,donate[:raise]`` arms the sanitizer at
+    import time.  A malformed value degrades to disabled-with-a-warning
+    rather than failing the import; unset is a strict no-op."""
+    raw = get_env("MXNET_SAN")
+    if not raw:
+        return False
+    try:
+        checkers, mode = _parse_spec(raw)
+    except MXNetError as e:
+        warnings.warn("MXNET_SAN=%r: %s; sanitizer disabled" % (raw, e))
+        return False
+    if not checkers:
+        return False
+    return arm(checkers, mode)
+
+
+_autostart()

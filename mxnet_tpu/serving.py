@@ -58,6 +58,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as _np
 
 from .base import MXNetError, get_env
+from . import sanitize as _san
 from . import telemetry as _tel
 from .predictor import Predictor, read_checkpoint
 
@@ -207,6 +208,12 @@ class ServedModel(object):
             raise MXNetError("max_wait_ms must be >= 0")
         self._lock = threading.RLock()
         self._predictors = {}     # bucket size -> Predictor binding
+        # mxsan: the bucket-rung ladder is a jit cache (one Predictor
+        # binding per rung); the warmup budget is one miss per rung —
+        # any further miss means rungs are being rebuilt
+        self._san_cache = _san.register_cache(
+            "serving:%s" % self.name, kind="serving-rung", owner=self,
+            sizer=lambda m: len(m._predictors), warmup=len(self.buckets))
         self._queue = _queue_mod.Queue()
         self._thread = None
         self._closed = False
@@ -349,6 +356,7 @@ class ServedModel(object):
                              copy_params=False)
             with self._lock:
                 self._predictors[bucket] = pred
+            self._san_cache.miss({"bucket": bucket})
         return pred
 
     def _bucket_for(self, n):
@@ -421,12 +429,16 @@ class ServedModel(object):
                     padded[k] = buf
                 # batched staging: ONE forward call stages every padded
                 # input (at the binding's dtype) and runs the bucket's
-                # compiled program
-                pred.forward(**padded)
+                # compiled program.  mxsan SYNC treats the tick's forward
+                # as a hot region — only the row extraction below is a
+                # planned device->host transfer
+                with _san.hot_region("serve.batch"):
+                    pred.forward(**padded)
                 outs = [pred.get_output(j) for j in range(pred.num_outputs)]
                 # row extraction happens INSIDE the guard: an output
                 # without a leading batch axis must scatter as an error,
                 # not kill the batcher thread with futures unresolved
+                # mxlint: disable=SYNC001 planned d2h — rows scatter to the client futures
                 rows = [[_np.array(o[i]) for o in outs] for i in range(n)]
         except Exception as exc:   # scatter the failure, keep serving
             with self._lock:

@@ -26,9 +26,10 @@ from __future__ import annotations
 
 import numpy as _np
 
-from .base import MXNetError
+from .base import MXNetError, trace_env_key
 from . import ndarray as nd
 from . import random as _random
+from . import sanitize as _san
 
 __all__ = ["TrainStep", "EvalStep"]
 
@@ -439,9 +440,23 @@ class TrainStep(object):
             outs = tuple(o.astype(jnp.float32) for o in outs)
             return new_params, new_state, new_aux, new_lsc, outs
 
+        # collision-proof program names: mxsan's raw-jit watcher exempts
+        # this cache's inner names process-wide, so bare 'step'/'many'
+        # would also blind it to same-named user functions
+        step.__name__ = "mxtpu_step"
+        step_amp.__name__ = "mxtpu_step_amp"
         self._step_fn = step_amp if self._has_scale else step
         self._donate = (0, 1, 2, 3) if self._has_scale else (0, 1, 2)
         self._multi_cache = {}
+        # mxsan: run_steps' chunk programs are a jit cache too (keyed on
+        # (num_steps, stacked, trace-env snapshot) below)
+        self._san_cache = _san.register_cache(
+            "train_step.run_steps", kind="train_multi", owner=self,
+            sizer=lambda ts: len(ts._multi_cache),
+            # this instance's step jit ('step'/'step_amp') and the chunk
+            # program ('many') belong to tracked caches — the raw-jit
+            # watcher must not double-count their compiles
+            jit_names=("mxtpu_step", "mxtpu_step_amp", "mxtpu_many"))
         self._in_shardings = None
         self._out_shardings = None
         if mesh is not None:
@@ -539,6 +554,17 @@ class TrainStep(object):
                              for k, v in host.items()}
         return self._scale_state
 
+    def _donate_pairs(self, args):
+        """Labelled leaves of the donated argument pytrees, in donate_argnums
+        order (params, opt_state, aux[, loss-scale state]) — the mxsan
+        DONATE checker's naming source.  Built only while that checker is
+        armed."""
+        import jax
+        for name, tree in zip(("params", "opt_state", "aux",
+                               "loss_scale_state"), args):
+            for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+                yield name + jax.tree_util.keystr(path), leaf
+
     def amp_stats(self):
         """Host view of the loss-scale state: ``(scale, overflow_delta)``
         with the overflow (skipped-update) count as a delta since the
@@ -548,7 +574,8 @@ class TrainStep(object):
         if not self._has_scale or self._scale_state is None:
             return None
         import jax
-        host = jax.device_get(self._scale_state)
+        with _san.allow_sync("amp loss-scale telemetry"):
+            host = jax.device_get(self._scale_state)
         total = int(host["overflow"])
         delta = total - self._overflow_seen
         self._overflow_seen = total
@@ -697,7 +724,12 @@ class TrainStep(object):
         hyper = self.fopt.hyper(self.num_update)
         t0 = self.num_update
         self.num_update += num_steps + 1
-        fn = self._multi_cache.get((num_steps, stacked))
+        # the chunk body traces executor._Lowered.run, which consults the
+        # TRACE_ENV_DEFAULTS levers — key them (CKEY001) so toggling e.g.
+        # MXNET_STEM_FUSE between run_steps calls retraces instead of
+        # silently reusing the stale program
+        cache_key = (num_steps, stacked, trace_env_key())
+        fn = self._multi_cache.get(cache_key)
         if fn is None:
             step = self._step_fn
             if self._has_scale:
@@ -741,6 +773,7 @@ class TrainStep(object):
                     return step(p, s, a, last, rng, hyper,
                                 t0 + num_steps + 1)
 
+            many.__name__ = "mxtpu_many"
             if self.mesh is not None:
                 shardings = self._in_shardings
                 bi = 4 if self._has_scale else 3   # batch slot
@@ -759,14 +792,24 @@ class TrainStep(object):
             else:
                 fn = jax.jit(many, donate_argnums=self._donate,
                              compiler_options=_xla_options())
-            self._multi_cache[(num_steps, stacked)] = fn
+            self._multi_cache[cache_key] = fn
+            self._san_cache.miss({"num_steps": num_steps,
+                                  "stacked": stacked,
+                                  "trace_env": cache_key[2]})
+        args = (params, opt_state, aux)
         if self._has_scale:
-            res = fn(params, opt_state, aux, self._scale_state_dev(), batch,
-                     rng, hyper, _np.int32(t0))
+            args = args + (self._scale_state_dev(),)
+        if _san._donate_on:
+            _san.check_donated("run_steps", self._donate_pairs(args))
+        with _san.hot_region("run_steps"):
+            res = fn(*(args + (batch, rng, hyper, _np.int32(t0))))
+        if _san._donate_on:
+            _san.note_donated("run_steps", self._donate_pairs(args),
+                              step=self.num_update)
+        if self._has_scale:
             self._scale_state = res[3]
             return res[0], res[1], res[2], res[4]
-        return fn(params, opt_state, aux, batch, rng, hyper,
-                  _np.int32(t0))
+        return res
 
     # ------------------------------------------------------------------- call
     def __call__(self, params, opt_state, aux, batch, rng=None):
@@ -781,20 +824,31 @@ class TrainStep(object):
         args = (params, opt_state, aux)
         if self._has_scale:
             args = args + (self._scale_state_dev(),)
-        with _profiler.Scope("train_step[%d]" % self.num_update, "symbolic"):
+        if _san._donate_on:
+            # a buffer donated by an earlier step re-entering here is the
+            # delete-on-donate bug — name it before XLA crashes cryptically
+            _san.check_donated("train_step", self._donate_pairs(args))
+        with _profiler.Scope("train_step[%d]" % self.num_update,
+                             "symbolic"), \
+                _san.hot_region("train_step"):
             if _tel._enabled:
                 with _tel.span("train_step", cat="executor", mirror=False,
                                num_update=self.num_update):
                     res = self._step(*args, batch, rng, hyper,
                                      _np.int32(self.num_update))
                     import jax
-                    jax.block_until_ready(res[-1])  # span reads device time
+                    with _san.allow_sync("telemetry span device time"):
+                        jax.block_until_ready(res[-1])
             else:
                 res = self._step(*args, batch, rng, hyper,
                                  _np.int32(self.num_update))
                 if _profiler.is_running():
                     import jax
-                    jax.block_until_ready(res[-1])
+                    with _san.allow_sync("profiler device time"):
+                        jax.block_until_ready(res[-1])
+        if _san._donate_on:
+            _san.note_donated("train_step", self._donate_pairs(args),
+                              step=self.num_update)
         if self._has_scale:
             self._scale_state = res[3]
             res = (res[0], res[1], res[2], res[4])

@@ -1,0 +1,12 @@
+"""Fixture stand-in for mxnet_tpu.base (parse-only, never imported)."""
+
+
+def get_env(name, default=None, typ=None):
+    return default
+
+
+TRACE_ENV_DEFAULTS = ()
+
+
+def trace_env_key():
+    return ()

@@ -1,0 +1,33 @@
+"""CKEY001 seeded violation: the PR-7 class — a lever consulted while
+tracing that the jit cache key does not carry, so a toggle between calls
+silently reuses the stale compiled program."""
+from .base import get_env
+
+
+class _Lowered(object):
+    def run(self, args, is_train):
+        # read at trace time (the lowering pass) — must key every cache
+        # whose jits trace this body
+        flavor = get_env("MXNET_FIXTURE_FLAVOR", "a")
+        if flavor == "b":
+            args = list(reversed(args))
+        return self._emit(args, is_train)
+
+    def _emit(self, args, is_train):
+        # reachable from run(): a second lever, read one call deep
+        if get_env("MXNET_FIXTURE_MODE", "x") == "y":
+            return args[:1]
+        return args
+
+
+class Executor(object):
+    def _get_jit(self, kind):
+        cache_key = (kind,)        # neither fixture lever keyed: findings
+        fn = self._jit_cache.get(cache_key)
+        if fn is None:
+            fn = self._compile(kind)
+            self._jit_cache[cache_key] = fn
+        return fn
+
+    def _walk(self, vals, is_train):
+        return vals

@@ -1,0 +1,15 @@
+"""Fixture stand-in for mxnet_tpu.base (parse-only, never imported)."""
+
+
+def get_env(name, default=None, typ=None):
+    return default
+
+
+# the shared trace-env registry: every executor jit keys on its snapshot
+TRACE_ENV_DEFAULTS = (
+    ("MXNET_FIXTURE_MODE", "x"),
+)
+
+
+def trace_env_key():
+    return tuple(get_env(n, d) for n, d in TRACE_ENV_DEFAULTS)

@@ -1,0 +1,32 @@
+"""CKEY001 clean twin: both trace-time levers appear in the cache key —
+one read directly in the key expression, one through the shared
+``trace_env_key()`` registry snapshot."""
+from .base import get_env, trace_env_key
+
+
+class _Lowered(object):
+    def run(self, args, is_train):
+        flavor = get_env("MXNET_FIXTURE_FLAVOR", "a")
+        if flavor == "b":
+            args = list(reversed(args))
+        return self._emit(args, is_train)
+
+    def _emit(self, args, is_train):
+        if get_env("MXNET_FIXTURE_MODE", "x") == "y":
+            return args[:1]
+        return args
+
+
+class Executor(object):
+    def _get_jit(self, kind):
+        cache_key = (kind,
+                     get_env("MXNET_FIXTURE_FLAVOR", "a"),
+                     trace_env_key())
+        fn = self._jit_cache.get(cache_key)
+        if fn is None:
+            fn = self._compile(kind)
+            self._jit_cache[cache_key] = fn
+        return fn
+
+    def _walk(self, vals, is_train):
+        return vals

@@ -38,6 +38,7 @@ CASES = [
     ("env", "ENV001", ("pkg",), "base.get_env"),
     ("noop", "NOOP001", ("pkg",), "without an env guard"),
     ("thr", "THR001", ("pkg",), "lock-free"),
+    ("ckey", "CKEY001", ("mxnet_tpu",), "cache key"),
 ]
 
 
@@ -95,6 +96,41 @@ def test_env_catches_every_drift_class():
     assert "is read by code but undocumented" in msgs
     assert "nothing in the code reads it" in msgs
     assert "promote it to a real table row" in msgs
+
+
+def test_ckey_names_the_missing_lever_and_propagates():
+    """CKEY001 = the PR-7 cache-key class, statically: both the lever
+    read directly in the traced root and the one read a call deep must
+    be named, anchored at the key-building function."""
+    findings, _, _ = run_fixture("ckey_bad", "CKEY001", ("mxnet_tpu",))
+    msgs = " / ".join(f.message for f in findings)
+    assert "MXNET_FIXTURE_FLAVOR" in msgs
+    assert "MXNET_FIXTURE_MODE" in msgs          # via call propagation
+    assert all(f.context == "Executor._get_jit" for f in findings)
+    # the clean twin covers one var literally in the key expression and
+    # the other through the trace_env_key() registry snapshot
+    clean, _, _ = run_fixture("ckey_clean", "CKEY001", ("mxnet_tpu",))
+    assert clean == [], [str(f) for f in clean]
+
+
+def test_ckey_repo_caches_cover_their_trace_reads():
+    """The repo-level contract CKEY001 now enforces: every env var
+    executor._Lowered.run consults while tracing is covered by the
+    fused-fit and run_steps cache keys (the PR-9 fixes)."""
+    from tools.mxlint.core import Project
+    from tools.mxlint import rule_ckey
+    p = Project(ROOT)
+    reads = set(rule_ckey._reachable_env_reads(
+        p.file("mxnet_tpu/executor.py"), "_Lowered.run"))
+    assert reads, "expected trace-time env reads in _Lowered.run"
+    tv = rule_ckey._project_trace_vars(p)
+    ev = rule_ckey._project_env_attr_vars(p)
+    for rel, qual in (("mxnet_tpu/module/module.py",
+                       "_fused_fit_key_fields"),
+                      ("mxnet_tpu/train.py", "TrainStep.run_steps"),
+                      ("mxnet_tpu/executor.py", "Executor._get_jit")):
+        covered = rule_ckey._key_vars(p, p.file(rel), qual, tv, ev)
+        assert reads <= covered, (rel, qual, sorted(reads - covered))
 
 
 def test_thr_module_scope_and_class_scope():

@@ -341,8 +341,13 @@ def test_crash_snapshot_inactive_without_optin(tmp_path, monkeypatch):
 def test_xla_compile_span_tagged_with_kind():
     """The jit-cache miss path's first call records an xla_compile span
     per kind; cache hits add none; the jit_cache_size gauge tracks."""
+    import gc
     tel.start()
     try:
+        # the gauge is the LIVE total over sanitize.register_cache (dead
+        # owners drop out via weakref) — collect earlier tests' dead
+        # executors NOW so the deltas below see a stable registry
+        gc.collect()
         ex = _small_net().simple_bind(mx.cpu(), data=(4, 6),
                                       softmax_label=(4,))
         ex.forward(is_train=False, data=mx.nd.array(RS(0).rand(4, 6)))
@@ -364,6 +369,10 @@ def test_xla_compile_span_tagged_with_kind():
                  if e["type"] == "span" and e["name"] == "xla_compile"}
         assert kinds == {"fwd_test", "grad"}
         assert tel.gauges()["jit_cache_size"] == size1 + 1
+        # and the published value IS the registry total (executor kinds +
+        # imperative op keys + fused/serving entries all counted)
+        from mxnet_tpu import sanitize as san
+        assert tel.gauges()["jit_cache_size"] == san.total_cache_entries()
     finally:
         tel.stop()
 

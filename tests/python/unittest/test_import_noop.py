@@ -40,6 +40,7 @@ sys.addaudithook(_audit)
 
 import mxnet_tpu
 import mxnet_tpu.telemetry
+import mxnet_tpu.sanitize
 import mxnet_tpu.metrics_server
 import mxnet_tpu.diagnostics
 import mxnet_tpu.profiler
@@ -47,6 +48,14 @@ import mxnet_tpu.io
 import mxnet_tpu.image
 import mxnet_tpu.engine
 import mxnet_tpu.serving
+
+# mxsan's no-op contract is wider than threads/files: no patched jax
+# function and no logging handler either (sanitize's "no hook" clause)
+import logging
+assert mxnet_tpu.sanitize.armed() == frozenset(), "sanitizer armed"
+assert not hasattr(jax.device_get, "_mxsan_orig"), "jax patched"
+assert logging.getLogger("jax._src.interpreters.pxla").handlers == [], \
+    "compile-log handler installed"
 
 new_threads = [t.name for t in threading.enumerate()
                if t.ident not in baseline_threads]

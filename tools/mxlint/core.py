@@ -81,12 +81,13 @@ def _discover(root, targets):
 
 
 def all_rules():
-    from . import rule_jit, rule_sync, rule_env, rule_noop, rule_thread
+    from . import (rule_jit, rule_sync, rule_env, rule_noop, rule_thread,
+                   rule_ckey)
     return {m.RULE: m for m in (rule_jit, rule_sync, rule_env, rule_noop,
-                                rule_thread)}
+                                rule_thread, rule_ckey)}
 
 
-ALL_RULES = ("JIT001", "SYNC001", "ENV001", "NOOP001", "THR001")
+ALL_RULES = ("JIT001", "SYNC001", "ENV001", "NOOP001", "THR001", "CKEY001")
 
 
 def lint(root, targets=DEFAULT_TARGETS, rules=None,

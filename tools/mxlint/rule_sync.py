@@ -32,6 +32,13 @@ HOT_PATHS = {
         "DataParallelExecutorGroup.backward"),
     "mxnet_tpu/executor.py": ("Executor.forward", "Executor.backward"),
     "mxnet_tpu/train.py": ("TrainStep.__call__", "EvalStep.__call__"),
+    # PR 7/8 hot paths (predating mxlint): the serving batcher's tick —
+    # one coalesced forward per tick, its only legitimate d2h transfer
+    # is the row scatter — and the device-prefetch producer thread,
+    # whose whole point is that staging must never block on a sync
+    "mxnet_tpu/serving.py": ("ServedModel._batch_loop",
+                             "ServedModel._run_batch"),
+    "mxnet_tpu/io.py": ("DevicePrefetchIter._producer",),
 }
 
 # identifiers that mark an opt-in observability/diagnostics branch
