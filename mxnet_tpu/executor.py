@@ -85,6 +85,35 @@ def _get_scale_backward():
     return fn
 
 
+class _Stage(object):
+    """One pipeline stage of a partitioned symbol graph: a contiguous
+    sub-range of the topological op order plus the variables it binds and
+    the activation frontier it exchanges with its neighbours (see
+    ``_Lowered.stage_partition``)."""
+
+    __slots__ = ("index", "final", "nodes", "params", "aux", "inputs",
+                 "carry_in", "carry_out")
+
+    def __init__(self, index, final, nodes, params, aux, inputs,
+                 carry_in, carry_out):
+        self.index = index
+        self.final = final
+        self.nodes = nodes          # var + op nodes, original topo order
+        self.params = params        # parameter names bound by this stage
+        self.aux = aux              # aux (BN moving stat) names
+        self.inputs = inputs        # data/label input names consumed here
+        self.carry_in = carry_in    # value keys received from earlier stages
+        self.carry_out = carry_out  # value keys handed to later stages
+
+    def describe(self):
+        return {"index": self.index, "final": self.final,
+                "ops": sum(1 for n in self.nodes if not n.is_var),
+                "params": list(self.params), "aux": list(self.aux),
+                "inputs": list(self.inputs),
+                "carry_in": len(self.carry_in),
+                "carry_out": len(self.carry_out)}
+
+
 class _Lowered(object):
     """The pure-functional form of a symbol graph."""
 
@@ -239,6 +268,171 @@ class _Lowered(object):
                 self.nc_stats_src[b_id] = src
                 self.nc_stats_for.setdefault(id(src), []).append(b_id)
 
+    # ------------------------------------------------------ pipeline stages
+    def _glue_edges(self):
+        """Op-order index pairs (lo, hi) that must stay in one stage: the
+        fusion peepholes (BN+relu, stem BN+conv, NormConv prologue/epilogue)
+        rewrite both members together, so a stage cut between them would
+        change which programs the single-program step and the pipelined
+        stages trace."""
+        op_pos = {}
+        for n in self.order:
+            if not n.is_var:
+                op_pos[id(n)] = len(op_pos)
+        edges = []
+
+        def edge(a_id, b_id):
+            pa, pb = op_pos.get(a_id), op_pos.get(b_id)
+            if pa is not None and pb is not None and pa != pb:
+                edges.append((min(pa, pb), max(pa, pb)))
+        for bn_id, act in self.fused_relu.items():
+            edge(bn_id, id(act))
+        for bn_id, info in self.stem_fuse.items():
+            edge(bn_id, id(info["conv"]))
+        for bn_id, info in self.nc_bn.items():
+            if info["act"] is not None:
+                edge(bn_id, id(info["act"]))
+            for c in info["convs"]:
+                edge(bn_id, id(c))
+        for bn_id, src in self.nc_stats_src.items():
+            edge(id(src), bn_id)
+        return op_pos, edges
+
+    def stage_partition(self, num_stages, input_names=(), param_sizes=None):
+        """Partition the op sequence into ``num_stages`` contiguous stages
+        (the GPipe layer split, rebuilt on the nnvm-style graph: PAPER.md
+        §4a partitions the executor graph the same way).
+
+        Cuts land only on glue-legal boundaries (no fusion pair straddles a
+        stage edge) and balance the per-stage parameter footprint when
+        ``param_sizes`` ({name: element count}) is given, op count
+        otherwise.  Each variable is assigned to the stage that consumes
+        it; a *parameter/aux* consumed by more than one stage has no single
+        home device and is rejected (weight sharing across stages needs
+        replication the pp axis exists to avoid).  Data/label inputs may
+        feed any number of stages.  The activation frontier between stages
+        s and s+1 is every value produced at or before s and consumed
+        after s (symbol outputs ride the frontier to the final stage)."""
+        input_names = set(input_names)
+        op_nodes = [n for n in self.order if not n.is_var]
+        if num_stages < 1:
+            raise MXNetError("stage_partition: num_stages must be >= 1")
+        if num_stages > len(op_nodes):
+            raise MXNetError(
+                "stage_partition: %d stages > %d ops in the graph"
+                % (num_stages, len(op_nodes)))
+        op_pos, glue = self._glue_edges()
+        illegal = set()
+        for lo, hi in glue:
+            illegal.update(range(lo + 1, hi + 1))
+
+        # per-op weight: parameters first consumed by this op (placement
+        # follows first consumption), plus 1 so op-only regions still
+        # spread across stages
+        first_consumer = {}    # var name -> op position of first consumer
+        for n in op_nodes:
+            for c, _ in n.inputs:
+                if c.is_var and c.name not in first_consumer:
+                    first_consumer[c.name] = op_pos[id(n)]
+        weights = [1.0] * len(op_nodes)
+        if param_sizes:
+            for name, pos in first_consumer.items():
+                weights[pos] += float(param_sizes.get(name, 0))
+
+        # greedy balanced cut: close each stage at the first legal boundary
+        # past its share of the remaining weight, keeping one op per
+        # remaining stage
+        cuts = []
+        pos = 0
+        for s in range(num_stages - 1):
+            remaining = sum(weights[pos:])
+            target = remaining / (num_stages - s)
+            acc = 0.0
+            cut = None
+            for k in range(pos, len(op_nodes) - (num_stages - 1 - s)):
+                acc += weights[k]
+                if acc >= target and (k + 1) not in illegal:
+                    cut = k + 1
+                    break
+            if cut is None:
+                # fall back to the first legal boundary that still leaves
+                # enough ops for the remaining stages
+                for k in range(pos, len(op_nodes) - (num_stages - 1 - s)):
+                    if (k + 1) not in illegal:
+                        cut = k + 1
+                        break
+            if cut is None:
+                raise MXNetError(
+                    "stage_partition: no legal cut for stage %d of %d "
+                    "(fusion glue spans the remaining ops)"
+                    % (s + 1, num_stages))
+            cuts.append(cut)
+            pos = cut
+        bounds = [0] + cuts + [len(op_nodes)]
+
+        def stage_of_op(p):
+            for s in range(num_stages):
+                if bounds[s] <= p < bounds[s + 1]:
+                    return s
+            raise MXNetError("unreachable")
+
+        # value keys (producer, out_idx) consumed by each op; producer
+        # stage for every non-var value
+        prod_stage = {}
+        for n in op_nodes:
+            for i in range(n.op.num_outputs_for(n.params)):
+                prod_stage[(id(n), i)] = stage_of_op(op_pos[id(n)])
+        consumers = {}      # value key -> set of consuming stages
+        var_stages = {}     # var name -> set of consuming stages
+        for n in op_nodes:
+            s = stage_of_op(op_pos[id(n)])
+            for c, i in n.inputs:
+                if c.is_var:
+                    var_stages.setdefault(c.name, set()).add(s)
+                else:
+                    consumers.setdefault((id(c), i), set()).add(s)
+        # symbol outputs must reach the final stage
+        for k in self.out_keys:
+            consumers.setdefault(k, set()).add(num_stages - 1)
+
+        aux_set = set(self.aux_names)
+        for name, stages in sorted(var_stages.items()):
+            if name in input_names or len(stages) == 1:
+                continue
+            kind = "aux state" if name in aux_set else "parameter"
+            raise MXNetError(
+                "stage_partition: %s %s is consumed by stages %s — "
+                "cross-stage weight sharing is not supported by the "
+                "pipeline schedule" % (kind, name, sorted(stages)))
+
+        # frontier after stage s: produced <= s, consumed > s; ordered by
+        # producer topo position for a deterministic jit interface
+        frontiers = []
+        for s in range(num_stages - 1):
+            keys = [k for k, cons in consumers.items()
+                    if k in prod_stage and prod_stage[k] <= s
+                    and any(cs > s for cs in cons)]
+            keys.sort(key=lambda k: (self.uid[k[0]]
+                                     if k[0] in self.uid else 0, k[1]))
+            frontiers.append(keys)
+
+        stages = []
+        for s in range(num_stages):
+            ops = set(id(n) for n in op_nodes[bounds[s]:bounds[s + 1]])
+            svars = {name for name, st in var_stages.items() if s in st}
+            nodes = [n for n in self.order
+                     if (n.is_var and n.name in svars) or id(n) in ops]
+            params = [n for n in self.arg_names
+                      if n in svars and n not in input_names]
+            aux = [n for n in self.aux_names if n in svars]
+            inputs = [n for n in sorted(svars & input_names)]
+            stages.append(_Stage(
+                index=s, final=(s == num_stages - 1), nodes=nodes,
+                params=params, aux=aux, inputs=inputs,
+                carry_in=list(frontiers[s - 1]) if s else [],
+                carry_out=list(frontiers[s]) if s < num_stages - 1 else []))
+        return stages
+
     def _nc_run_bn(self, node, values, nhwc, aux_updates, nc_ctx, is_train,
                    skip):
         """Resolve a fused BatchNorm to per-channel (scale, shift): stats
@@ -370,7 +564,8 @@ class _Lowered(object):
         return True
 
     def run(self, arg_vals, aux_vals, rng, is_train, collect=False,
-            no_grad_inputs=(), head_grad_scale=None):
+            no_grad_inputs=(), head_grad_scale=None, stage=None,
+            carry_vals=None):
         """Trace the graph: dict name->array in, (outputs, aux_updates) out.
         With collect=True also returns {internal_name: value} for every op
         output — the monitor's data, gathered from the ONE real execution.
@@ -378,6 +573,15 @@ class _Lowered(object):
         ``head_grad_scale`` (a traced scalar; AMP loss scaling) wraps every
         loss head's data input in the scale-backward identity so the whole
         backward chain below the heads sees scaled cotangents.
+
+        ``stage`` (a ``_Stage`` from :meth:`stage_partition`) restricts the
+        trace to that stage's node sub-range: ``carry_vals`` seeds the
+        activation frontier received from the previous stage (logical-NCHW
+        arrays, in ``stage.carry_in`` order) and the return becomes the
+        3-tuple ``(outputs, aux_updates, carry_out)`` — ``outputs`` only on
+        the final stage, ``carry_out`` restored to logical layout so the
+        stage boundary is a deterministic interface regardless of the
+        layout pass's channel-last tagging inside the stage.
 
         Layout pass (TPU-native; no reference analogue — the nnvm graph never
         needed one because cuDNN consumed NCHW directly): XLA:TPU inserts
@@ -410,6 +614,14 @@ class _Lowered(object):
         nhwc = set()      # value keys currently stored channel-last
         aux_updates = {}
         collected = {}
+        order = self.order
+        if stage is not None:
+            if collect:
+                raise MXNetError("monitor collection is not supported on "
+                                 "the pipeline stage path")
+            order = stage.nodes
+            for key, v in zip(stage.carry_in, carry_vals or ()):
+                values[key] = v
 
         def is_arr(v):
             return hasattr(v, "ndim") and v.ndim >= 3
@@ -421,7 +633,7 @@ class _Lowered(object):
             return jnp.moveaxis(v, -1, 1)
 
         skip = set()
-        for node in self.order:
+        for node in order:
             if node.is_var:
                 if node.name in arg_vals:
                     values[(id(node), 0)] = arg_vals[node.name]
@@ -530,6 +742,12 @@ class _Lowered(object):
                     child = node.inputs[pos][0]
                     if child.is_var and is_train:
                         aux_updates[child.name] = out[n_vis + k]
+        if stage is not None:
+            carry_out = [to_cf(values[k]) if k in nhwc else values[k]
+                         for k in stage.carry_out]
+            outputs = [to_cf(values[k]) if k in nhwc else values[k]
+                       for k in self.out_keys] if stage.final else []
+            return outputs, aux_updates, carry_out
         outputs = [to_cf(values[k]) if k in nhwc else values[k]
                    for k in self.out_keys]
         if collect:

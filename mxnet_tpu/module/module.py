@@ -416,6 +416,7 @@ class Module(BaseModule):
         from ..base import get_env
         from .. import amp as _amp
         policy = _amp.resolve_policy(policy)
+        pp_req = get_env("MXNET_PP", None, typ=int)
 
         def fallback(why):
             # the general path is ~3.4x slower per batch (docs/perf.md);
@@ -425,18 +426,27 @@ class Module(BaseModule):
                 # would train f32 while the operator believes bf16
                 why += " (MXNET_AMP/policy ignored: the general path "\
                        "trains f32)"
+            if pp_req and pp_req > 1:
+                # same contract for pipeline stages: never train
+                # single-program while the operator believes pp
+                why += " (MXNET_PP ignored: the general path is "\
+                       "single-program)"
             logging.info("Module.fit: general (executor) path — %s", why)
             return None
 
         if get_env("MXNET_FUSED_FIT", "1") == "0":
             return fallback("MXNET_FUSED_FIT=0")
         from .. import telemetry as _tel
-        if _tel.enabled() and get_env("MXNET_TELEMETRY_FUSED", "0") != "1":
+        if _tel.enabled() and get_env("MXNET_TELEMETRY_FUSED", "0") != "1" \
+                and not (pp_req and pp_req > 1):
             # the fused step is ONE XLA program — it cannot be split into
             # forward/backward/update spans.  Telemetry implies the operator
             # wants the step-time breakdown, so run the general path; set
             # MXNET_TELEMETRY_FUSED=1 to keep the fused path (the breakdown
-            # then shows a single fused_step span per batch).
+            # then shows a single fused_step span per batch).  A requested
+            # pipeline (MXNET_PP) never downgrades here: the pipelined step
+            # emits its own per-stage breakdown (pp.stage spans), and the
+            # general path would silently change placement entirely.
             return fallback("telemetry step breakdown active "
                             "(MXNET_TELEMETRY_FUSED=1 keeps the fused path)")
         if len(self._context) != 1:
@@ -462,6 +472,11 @@ class Module(BaseModule):
             if isinstance(e, _san.SanitizerError):
                 raise   # a sanitizer contract violation in :raise mode is
                         # a finding, not a reason to fall back silently
+            if pp_req and pp_req > 1:
+                # the operator explicitly asked for pipeline stages — a
+                # mesh/partition error must halt, not silently train the
+                # whole model single-program
+                raise
             return fallback(str(e))
 
 
@@ -474,10 +489,14 @@ def _fused_fit_key_fields(opt, policy):
     are re-imported into the TrainStep separately).  The trace-env levers
     ARE part of the key (CKEY001): the step traces executor._Lowered.run,
     so toggling e.g. MXNET_STEM_FUSE between fit() calls must land on a
-    fresh compile, exactly like toggling MXNET_AMP.  mxsan's RECOMPILE
-    checker watches this cache through these named fields — a seeded
-    regression (step state re-entering the key) is named field-by-field."""
-    from ..base import trace_env_key
+    fresh compile, exactly like toggling MXNET_AMP.  The pipeline levers
+    (MXNET_PP / MXNET_PP_MICROBATCH, dispatch-time reads — docs/env_var.md
+    "Pipeline parallelism") key the cache the same way: toggling them
+    between fits swaps the TrainStep for a PipelineTrainStep (or back)
+    instead of reusing the stale step.  mxsan's RECOMPILE checker watches
+    this cache through these named fields — a seeded regression (step
+    state re-entering the key) is named field-by-field."""
+    from ..base import get_env, trace_env_key
     return {
         "optimizer": type(opt).__name__,
         "opt_hyper": tuple(sorted((k, v) for k, v in vars(opt).items()
@@ -488,6 +507,8 @@ def _fused_fit_key_fields(opt, policy):
         "wd_mult": tuple(sorted(getattr(opt, "wd_mult", {}).items())),
         "policy": policy.key() if policy is not None else None,
         "trace_env": trace_env_key(),
+        "pp": get_env("MXNET_PP", None, typ=int),
+        "pp_microbatch": get_env("MXNET_PP_MICROBATCH", None, typ=int),
     }
 
 
@@ -497,7 +518,7 @@ class _FusedFit(object):
     def __init__(self, module, policy=None):
         import jax
         from .. import sanitize as _san
-        from ..train import TrainStep
+        from ..train import TrainStep, PipelineTrainStep
         self._mod = module
         self._policy = policy
         # one XLA program per (optimizer config, precision policy,
@@ -507,6 +528,8 @@ class _FusedFit(object):
         opt = module._optimizer
         fields = _fused_fit_key_fields(opt, policy)
         key = tuple(sorted(fields.items()))
+        pp = fields["pp"]
+        self._pipeline = bool(pp and pp > 1)
         san = getattr(module, "_san_fused_cache", None)
         if san is None:
             san = module._san_fused_cache = _san.register_cache(
@@ -519,6 +542,27 @@ class _FusedFit(object):
             self._ts.optimizer = opt
             self._ts.fopt.opt = opt
             self._ts.num_update = 0
+        elif self._pipeline:
+            # MXNET_PP=<stages>: stage-partitioned, microbatched training
+            # over a dp x pp mesh of ALL local devices (the fit dispatch
+            # half of docs/distributed.md "Pipeline parallelism")
+            from ..parallel.mesh import make_pp_mesh
+            n_dev = len(jax.devices())
+            if n_dev % pp:
+                raise MXNetError(
+                    "MXNET_PP=%d needs a device count divisible by the "
+                    "stage count; have %d local device(s) (for virtual "
+                    "testing set XLA_FLAGS=--xla_force_host_platform_"
+                    "device_count=N)" % (pp, n_dev))
+            self._ts = PipelineTrainStep(
+                module._symbol, opt,
+                data_names=tuple(module._data_names),
+                label_names=tuple(module._label_names),
+                mesh=make_pp_mesh(pp),
+                num_microbatches=fields["pp_microbatch"],
+                policy=policy)
+            module._fused_ts_cache = (key, self._ts)
+            san.miss(fields)
         else:
             self._ts = TrainStep(module._symbol, opt,
                                  data_names=tuple(module._data_names),
@@ -535,17 +579,28 @@ class _FusedFit(object):
         dev = module._context[0].jax_device()
         self._dev = dev
         # loss-scale state follows the params onto the module's device
+        # (pipeline: it lives on the final stage's sub-mesh instead)
         self._ts._scale_device = dev
         arg_params, aux_params = module.get_params()
-        self._params = {n: jax.device_put(arg_params[n].asnumpy(), dev)
-                        for n in self._ts.param_names}
-        state = self._ts.fopt.init_state(
-            {n: arg_params[n].asnumpy() for n in self._ts.param_names})
-        self._state = {n: tuple(jax.device_put(s, dev) for s in st)
-                       for n, st in state.items()}
-        self._import_updater_state()
-        self._aux = {n: jax.device_put(aux_params[n].asnumpy(), dev)
-                     for n in self._ts.aux_names}
+        host_params = {n: arg_params[n].asnumpy()
+                       for n in self._ts.param_names}
+        state = self._ts.fopt.init_state(host_params)
+        if self._pipeline:
+            # every pytree lands on its stage's sub-mesh slice — the
+            # per-device parameter footprint drops ~1/pp vs replicated
+            self._params = self._ts.place_params(host_params)
+            self._state = self._ts.place_state(state)
+            self._import_updater_state()
+            self._aux = self._ts.place_aux(
+                {n: aux_params[n].asnumpy() for n in self._ts.aux_names})
+        else:
+            self._params = {n: jax.device_put(v, dev)
+                            for n, v in host_params.items()}
+            self._state = {n: tuple(jax.device_put(s, dev) for s in st)
+                           for n, st in state.items()}
+            self._import_updater_state()
+            self._aux = {n: jax.device_put(aux_params[n].asnumpy(), dev)
+                         for n in self._ts.aux_names}
         names = module._data_names + module._label_names
         self._input_names = names
 
@@ -574,8 +629,10 @@ class _FusedFit(object):
             vals = tuple(v for v in vals if v is not None)
             if len(vals) != len(self._state[name]):
                 continue  # layout mismatch (e.g. dcasgd's (mom, prev_w))
+            dst = self._ts.param_sharding(name) if self._pipeline \
+                else self._dev
             self._state[name] = tuple(
-                jax.device_put(v.asnumpy(), self._dev) for v in vals)
+                jax.device_put(v.asnumpy(), dst) for v in vals)
         # continue the update count (Adam bias correction, lr schedules)
         counts = getattr(self._mod._optimizer, "_index_update_count", None)
         if counts:
@@ -613,7 +670,11 @@ class _FusedFit(object):
         from .. import io as _io
         from ..parallel import mesh as _mesh
         depth = _io.device_prefetch_depth()
-        if depth == 0 or _mesh.sequence_mesh()[0] is not None:
+        if depth == 0 or _mesh.sequence_mesh()[0] is not None \
+                or self._pipeline:
+            # pipeline: the step splits each batch into microbatches and
+            # stages every slice onto its consuming stage's sub-mesh —
+            # single-device whole-batch staging would fight that placement
             return data_iter
         return _io.DevicePrefetchIter(data_iter, stage=self._stage,
                                       depth=depth)
@@ -640,8 +701,10 @@ class _FusedFit(object):
         self._mod._params_dirty = True
         self._mod._active_fused = self
         # labels staged onto the step's device so the metric's same-device
-        # lazy reduction engages
-        labels = [nd.NDArray(jax.device_put(batch[n], self._dev))
+        # lazy reduction engages (pipeline: the outputs live on the final
+        # stage's sub-mesh)
+        dst = self._ts.output_sharding() if self._pipeline else self._dev
+        labels = [nd.NDArray(jax.device_put(batch[n], dst))
                   for n in self._mod._label_names if n in batch]
         return [nd.NDArray(o) for o in outs], labels
 
@@ -656,23 +719,32 @@ class _FusedFit(object):
         mod = self._mod
         # COPIES, not aliases: the next fused step donates self._params/
         # _state/_aux to XLA — anything installed in the executors, kvstore
-        # or updater must own its buffer or it dies with the donation
-        params_cp = {n: jnp.copy(v) for n, v in self._params.items()}
-        aux_cp = {n: jnp.copy(v) for n, v in self._aux.items()}
-        arg = {n: nd.NDArray(v) for n, v in params_cp.items()}
-        aux = {n: nd.NDArray(v) for n, v in aux_cp.items()}
-        mod._exec_group.set_params(arg, aux)
-        if mod._arg_params is not None:
+        # or updater must own its buffer or it dies with the donation.
+        # (The pipeline path installs host-backed arrays instead, so the
+        # device copies would be dead weight there.)
+        params_cp = aux_cp = None
+        if not self._pipeline:
+            params_cp = {n: jnp.copy(v) for n, v in self._params.items()}
+            aux_cp = {n: jnp.copy(v) for n, v in self._aux.items()}
+        host_params = host_aux = None
+        if mod._arg_params is not None or self._pipeline:
             # Batched device->host transfer: concatenate on device, split on
             # host (jax.device_get fetches leaf by leaf — a round trip each on
-            # a tunneled TPU). One concat PER DTYPE: casting everything through
-            # f32 would silently truncate f64 or integer params/aux.
+            # a tunneled TPU). One concat PER (DTYPE, DEVICE GROUP): casting
+            # everything through f32 would silently truncate f64 or integer
+            # params/aux, and pipeline-stage arrays living on different
+            # sub-meshes cannot meet in one concatenation.
             items = [("arg", n, v) for n, v in sorted(self._params.items())] \
                 + [("aux", n, v) for n, v in sorted(self._aux.items())]
-            by_dtype = {}
+            by_group = {}
             for it in items:
-                by_dtype.setdefault(jnp.dtype(it[2].dtype), []).append(it)
-            for dt, group in by_dtype.items():
+                v = it[2]
+                devs = tuple(sorted(d.id for d in v.devices())) \
+                    if hasattr(v, "devices") else ()
+                by_group.setdefault((jnp.dtype(v.dtype), devs),
+                                    []).append(it)
+            host_params, host_aux = {}, {}
+            for _, group in by_group.items():
                 flat = _np.asarray(jnp.concatenate(
                     [v.reshape(-1) for _, _, v in group]))
                 ofs = 0
@@ -682,8 +754,22 @@ class _FusedFit(object):
                         size *= d
                     chunk = flat[ofs:ofs + size].reshape(v.shape)
                     ofs += size
-                    dst = mod._arg_params if kind == "arg" else mod._aux_params
-                    dst[n][:] = chunk
+                    (host_params if kind == "arg" else host_aux)[n] = chunk
+        if self._pipeline:
+            # per-stage sub-mesh arrays must not reach the executors (one
+            # later score()/forward() program cannot span the stages) —
+            # install host-backed copies instead
+            arg = {n: nd.array(v) for n, v in host_params.items()}
+            aux = {n: nd.array(v) for n, v in host_aux.items()}
+        else:
+            arg = {n: nd.NDArray(v) for n, v in params_cp.items()}
+            aux = {n: nd.NDArray(v) for n, v in aux_cp.items()}
+        mod._exec_group.set_params(arg, aux)
+        if mod._arg_params is not None:
+            for n, v in host_params.items():
+                mod._arg_params[n][:] = v
+            for n, v in host_aux.items():
+                mod._aux_params[n][:] = v
         mod._params_dirty = False
         mod._active_fused = None
         # an explicit kvstore holds its own stored weights (pull sources) —
@@ -691,9 +777,11 @@ class _FusedFit(object):
         if mod._kvstore is not None:
             store = getattr(mod._kvstore, "_store", None)
             if store:
+                # arg[name].value is the owned copy on both paths (host-
+                # backed for pipeline, the device copy otherwise)
                 for idx, name in enumerate(self._ts.param_names):
                     if idx in store:
-                        store[idx]._set_value(params_cp[name])
+                        store[idx]._set_value(arg[name].value)
         # continue the optimizer's update counts (Adam bias correction, lr
         # schedules) — _import_updater_state reads these back on the next fit
         opt = mod._optimizer

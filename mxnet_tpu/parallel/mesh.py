@@ -15,7 +15,8 @@ import numpy as _np
 from ..base import MXNetError
 
 __all__ = ["make_mesh", "data_parallel_mesh", "local_devices_for",
-           "set_sequence_mesh", "sequence_mesh", "mesh_cache_key"]
+           "set_sequence_mesh", "sequence_mesh", "mesh_cache_key",
+           "make_pp_mesh", "pp_submeshes"]
 
 
 def mesh_cache_key(mesh):
@@ -74,6 +75,38 @@ def make_mesh(axes, devices=None):
                          % (dict(zip(names, sizes)), n))
     dev_array = _np.asarray(devices).reshape(sizes)
     return Mesh(dev_array, tuple(names))
+
+
+def make_pp_mesh(pp, dp=None, devices=None):
+    """dp x pp mesh for pipeline-parallel training: ``pp`` is the minor
+    axis, so pipeline stage ``s`` owns the dp-slice ``devices[:, s]``
+    (consecutive slices of the pp axis — ``pp_submeshes`` cuts them out).
+    ``dp`` defaults to whatever the device count leaves over."""
+    return make_mesh({"dp": dp if dp is not None else -1, "pp": pp},
+                     devices=devices)
+
+
+def pp_submeshes(mesh, axis="pp"):
+    """The per-stage sub-meshes of a pipeline mesh: one Mesh per index of
+    ``axis``, keeping the remaining axes (stage s of a dp x pp mesh gets a
+    1-D dp mesh over its slice's devices).  A pure-pp mesh yields
+    single-device stages carrying a size-1 ``dp`` axis so the stage
+    programs keep one sharding interface."""
+    from jax.sharding import Mesh
+    if axis not in mesh.axis_names:
+        raise MXNetError("pp_submeshes: mesh %r has no %r axis"
+                         % (tuple(mesh.axis_names), axis))
+    ax = list(mesh.axis_names).index(axis)
+    names = tuple(n for n in mesh.axis_names if n != axis)
+    subs = []
+    for s in range(mesh.devices.shape[ax]):
+        devs = _np.take(mesh.devices, s, axis=ax)
+        if not names:
+            devs = devs.reshape((1,))
+            subs.append(Mesh(devs, ("dp",)))
+        else:
+            subs.append(Mesh(devs, names))
+    return subs
 
 
 def data_parallel_mesh(ctx_list=None):

@@ -31,12 +31,92 @@ from . import ndarray as nd
 from . import random as _random
 from . import sanitize as _san
 
-__all__ = ["TrainStep", "EvalStep"]
+__all__ = ["TrainStep", "EvalStep", "PipelineTrainStep",
+           "pipeline_bubble_fraction"]
+
+
+def pipeline_bubble_fraction(pp, microbatches):
+    """Idle-slot share of the executed GPipe schedule: each of the ``pp``
+    stages is busy for ``M`` of the ``M + pp - 1`` slot-times of both the
+    forward and backward waves, so the fill/drain bubble is
+    ``(pp - 1) / (pp - 1 + M)`` — shrinking as the microbatch count grows."""
+    return float(pp - 1) / float(pp - 1 + microbatches)
 
 
 def _pspec(*names):
     from jax.sharding import PartitionSpec
     return PartitionSpec(*names)
+
+
+def _flat_shards(x, dp):
+    """Logical tensor -> flat (dp, chunk) view, zero-padded; device i owns
+    row i.  Elementwise optimizer math commutes with this view (the ZeRO-1
+    shard layout, shared by TrainStep and PipelineTrainStep)."""
+    import jax.numpy as jnp
+    size = 1
+    for d in x.shape:
+        size *= d
+    chunk = -(-size // dp)
+    flat = jnp.reshape(x, (-1,))
+    pad = dp * chunk - size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return jnp.reshape(flat, (dp, chunk))
+
+
+def _from_flat_shards(xf, shape):
+    import jax.numpy as jnp
+    size = 1
+    for d in shape:
+        size *= d
+    return jnp.reshape(jnp.reshape(xf, (-1,))[:size], shape)
+
+
+def _host_init(symbol, low, param_names, aux_names, data_shapes,
+               label_shapes, initializer, seed, who):
+    """Host-side parameter/aux initialisation shared by TrainStep and
+    PipelineTrainStep.init: initialise on the cpu context (under a remote
+    accelerator the per-param imperative ops would otherwise pay a tunnel
+    round-trip each) — the finished tensors move to the devices in one
+    hop at placement time."""
+    from . import initializer as init_mod
+    if initializer is None:
+        initializer = init_mod.Xavier(magnitude=2.0)
+    shapes = dict(data_shapes)
+    if label_shapes:
+        shapes.update(label_shapes)
+    arg_shapes, _, aux_shapes = symbol.infer_shape(**shapes)
+    if arg_shapes is None:
+        raise MXNetError("%s.init: shape inference incomplete" % who)
+    name2shape = dict(zip(low.arg_names, arg_shapes))
+    _random.seed(seed)
+    params = {}
+    from .context import cpu as _cpu_ctx
+    attrs = symbol.attr_dict()
+    with _cpu_ctx():
+        for n in param_names:
+            arr = nd.zeros(name2shape[n])
+            initializer(init_mod.InitDesc(n, attrs.get(n)), arr)
+            params[n] = arr.value
+    aux = {}
+    for n, shape in zip(aux_names, aux_shapes):
+        aux[n] = _np.ones(shape, _np.float32) \
+            if ("moving_var" in n or "_var" in n) \
+            else _np.zeros(shape, _np.float32)
+    return params, aux
+
+
+def _zero_state_host(fopt, params, dp):
+    """ZeRO-1 optimizer state born as flat (dp, chunk) host templates —
+    padded param values, so dcasgd's prev-weight state starts AT the
+    weight exactly as in replicated mode."""
+    def flat_np(v):
+        v = _np.asarray(v)
+        chunk = -(-v.size // dp)
+        out = _np.zeros((dp, chunk), v.dtype)
+        out.reshape(-1)[:v.size] = v.reshape(-1)
+        return out
+    return fopt.init_state({n: flat_np(v) for n, v in params.items()})
 
 
 def _xla_options():
@@ -507,25 +587,10 @@ class TrainStep(object):
         return -(-size // self._dp)
 
     def _to_shards(self, x):
-        """Logical tensor -> flat (dp, chunk) view, zero-padded; device i
-        owns row i.  Elementwise optimizer math commutes with this view."""
-        import jax.numpy as jnp
-        size = 1
-        for d in x.shape:
-            size *= d
-        chunk = self._chunk(size)
-        flat = jnp.reshape(x, (-1,))
-        pad = self._dp * chunk - size
-        if pad:
-            flat = jnp.pad(flat, (0, pad))
-        return jnp.reshape(flat, (self._dp, chunk))
+        return _flat_shards(x, self._dp)
 
     def _from_shards(self, xf, shape):
-        import jax.numpy as jnp
-        size = 1
-        for d in shape:
-            size *= d
-        return jnp.reshape(jnp.reshape(xf, (-1,))[:size], shape)
+        return _from_flat_shards(xf, shape)
 
     # ------------------------------------------------------------ loss scale
     def _scale_state_dev(self):
@@ -587,49 +652,12 @@ class TrainStep(object):
         optimizer state.  Returns (params, opt_state, aux) pytrees of
         jax.Arrays, placed according to the mesh."""
         import jax
-        from . import initializer as init_mod
-        if initializer is None:
-            initializer = init_mod.Xavier(magnitude=2.0)
-        shapes = dict(data_shapes)
-        if label_shapes:
-            shapes.update(label_shapes)
-        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shapes)
-        if arg_shapes is None:
-            raise MXNetError("TrainStep.init: shape inference incomplete")
-        name2shape = dict(zip(self._low.arg_names, arg_shapes))
-        aux2shape = dict(zip(self.aux_names, aux_shapes))
-        _random.seed(seed)
-        params = {}
-        # initialise host-side (cpu context): under a remote accelerator the
-        # per-param imperative ops would otherwise pay a tunnel round-trip
-        # each; the finished tensors move to the devices in one hop below
-        from .context import cpu as _cpu_ctx
-        attrs = self.symbol.attr_dict()
-        with _cpu_ctx():
-            for n in self.param_names:
-                arr = nd.zeros(name2shape[n])
-                initializer(init_mod.InitDesc(n, attrs.get(n)), arr)
-                params[n] = arr.value
-        aux = {}
-        for n in self.aux_names:
-            v = _np.ones(aux2shape[n], _np.float32) \
-                if ("moving_var" in n or "_var" in n) \
-                else _np.zeros(aux2shape[n], _np.float32)
-            aux[n] = v
+        params, aux = _host_init(self.symbol, self._low, self.param_names,
+                                 self.aux_names, data_shapes, label_shapes,
+                                 initializer, seed, "TrainStep")
         if self.zero:
-            # optimizer state is born sharded: flat (dp, chunk) host
-            # templates (padded param values, so dcasgd's prev-weight
-            # state starts AT the weight exactly as in replicated mode)
-            dp = self._dp
-
-            def flat_np(v):
-                v = _np.asarray(v)
-                chunk = self._chunk(v.size)
-                out = _np.zeros((dp, chunk), v.dtype)
-                out.reshape(-1)[:v.size] = v.reshape(-1)
-                return out
-            opt_state = self.fopt.init_state(
-                {n: flat_np(v) for n, v in params.items()})
+            # optimizer state is born sharded over dp
+            opt_state = _zero_state_host(self.fopt, params, self._dp)
         else:
             opt_state = self.fopt.init_state(params)
         if self.mesh is None:
@@ -917,3 +945,709 @@ class EvalStep(object):
         if rng is None:
             rng = _random.next_key()
         return self._fwd(params, aux, batch, rng)
+
+
+class PipelineTrainStep(object):
+    """Stage-partitioned, microbatched training over the ``pp`` mesh axis
+    (GPipe rebuilt TPU-natively; parity: the reference's executor graph
+    partitioning for model parallelism, PAPER.md §4a).
+
+    The symbol's op sequence is cut into ``pp`` contiguous stages
+    (``executor._Lowered.stage_partition`` — fusion-glue-legal cuts,
+    parameter-footprint balanced), stage ``s`` living on slice ``s`` of the
+    mesh's ``pp`` axis (``parallel.mesh.pp_submeshes``); each global batch
+    splits into ``M`` microbatches and runs the GPipe fill/steady/drain
+    schedule: a forward wave (per-stage jitted programs dispatched in
+    dependency order — stages on disjoint device slices overlap through
+    XLA's async dispatch), then a backward wave with per-stage gradient
+    accumulation, then one optimizer update per stage.  Activations cross
+    stage boundaries as explicit resharding transfers
+    (``jax.device_put`` onto the next stage's sub-mesh, dp-sharded), so the
+    runtime inserts the device-to-device copies.  The idle-slot share of
+    the executed schedule is ``(pp-1)/(pp-1+M)``
+    (:func:`pipeline_bubble_fraction`), shrinking as M grows.
+
+    Composition:
+    - **dp**: a ``dp x pp`` mesh shards every microbatch over the stage
+      sub-mesh's ``dp`` axis; XLA reduces the per-stage gradients over dp
+      inside each stage program.
+    - **AMP** (``policy=``): the loss scale is injected at the final
+      stage's loss heads (the executor scale-backward identity), rides the
+      carry cotangents through every stage, and the loss-scale state lives
+      donated on the final stage's sub-mesh; per-stage finite flags
+      combine there ON DEVICE, and each stage's update skips in a
+      ``lax.cond`` on overflow — no host syncs.
+    - **ZeRO-1** (``zero=True``): each stage's optimizer step shards over
+      its sub-mesh's dp axis exactly like ``TrainStep(zero=True)``.
+    - **donation**: per-stage params/optimizer state (and the loss-scale
+      state) are donated to their update programs; gradient accumulators
+      are donated through the backward wave.
+
+    Semantics vs the single-program ``TrainStep`` (same global batch, same
+    update count): per-sample loss heads (``normalization='null'``, the
+    default) accumulate to the identical gradient; ``'batch'``-normalized
+    heads are compensated exactly by folding ``1/M`` into the head-grad
+    scale; ``'valid'`` is rejected under M>1.  BatchNorm batch statistics
+    are computed per microbatch (the moving stats chain through the
+    microbatches in order), so BN nets match the single-program step
+    exactly only at M=1 — the standard gradient-accumulation caveat; see
+    docs/distributed.md "Pipeline parallelism".  The backward wave
+    rematerialises each stage's forward (GPipe's memory-lean schedule):
+    only the boundary activations of in-flight microbatches are stashed.
+
+    Call :meth:`init` (or the ``place_*`` helpers) before stepping — the
+    stage plan is balanced from real parameter sizes and every buffer is
+    placed on its stage's sub-mesh.
+    """
+
+    def __init__(self, symbol, optimizer, data_names=("data",),
+                 label_names=("softmax_label",), mesh=None,
+                 num_microbatches=None, zero=False, policy=None, dtype=None):
+        from .executor import _Lowered
+        if mesh is None or "pp" not in mesh.axis_names:
+            raise MXNetError(
+                "PipelineTrainStep needs a mesh with a 'pp' axis "
+                "(parallel.mesh.make_pp_mesh)")
+        extra = set(mesh.axis_names) - {"dp", "pp"}
+        if extra:
+            raise MXNetError(
+                "PipelineTrainStep composes with dp only; mesh axes %s "
+                "are not supported yet" % sorted(extra))
+        if policy is not None:
+            from . import amp as _amp
+            if dtype is not None:
+                raise MXNetError(
+                    "PipelineTrainStep: pass either dtype= (pure cast) or "
+                    "policy= (cast + loss scaling), not both")
+            policy = _amp.resolve_policy(policy)
+            if policy.compute_dtype != "float32":
+                dtype = policy.compute_dtype
+        self.policy = policy
+        self._has_scale = policy is not None
+        self._scale_state = None
+        self._scale_device = None     # _FusedFit compat (placement is
+        self._overflow_seen = 0       # per-stage here, not device-pinned)
+        self._amp_emit = True
+        self.symbol = symbol
+        self.mesh = mesh
+        shape = dict(mesh.shape)
+        self._pp = int(shape["pp"])
+        self._dp = int(shape.get("dp", 1))
+        self._micro = int(num_microbatches) if num_microbatches is not None \
+            else self._pp
+        if self._micro < 1:
+            raise MXNetError("PipelineTrainStep: num_microbatches must be "
+                             ">= 1, got %d" % self._micro)
+        self.zero = bool(zero)
+        if self.zero and "dp" not in mesh.axis_names:
+            raise MXNetError(
+                "PipelineTrainStep(zero=True) needs a mesh with a 'dp' "
+                "axis to shard the optimizer over")
+        self._dtype = dtype
+        self._low = _Lowered(symbol)
+        self.data_names = tuple(data_names)
+        self.label_names = tuple(label_names)
+        self._inputs_all = set(self.data_names) | set(self.label_names)
+        self.param_names = [n for n in self._low.arg_names
+                            if n not in self._inputs_all]
+        self.aux_names = list(self._low.aux_names)
+        self.fopt = _FunctionalOptimizer(optimizer, self.param_names)
+        self.optimizer = optimizer
+        self.num_update = 0
+        self.check_numerics = True
+        from .parallel import mesh as mesh_mod
+        self._subs = mesh_mod.pp_submeshes(mesh)
+        # stage plan is finalised lazily with real parameter sizes (init/
+        # place_params) so the cut balances the per-stage footprint
+        self._stages = None
+        self._var_stage = {}
+        self._stage_has_loss = None
+        self._micro_comp = False
+        self._progs = {}
+        # mxsan RECOMPILE: the per-(kind, stage, trace-env) program cache
+        # (CKEY001 CACHES entry: tools/mxlint/rule_ckey.py).  One env
+        # snapshot costs at most fwd/bwd/upd/zeros per stage plus the AMP
+        # fin/auxsel/scale programs.
+        self._san_cache = _san.register_cache(
+            "pipeline.stages", kind="pipeline", owner=self,
+            sizer=lambda ps: len(ps._progs), warmup=7 * self._pp + 2,
+            jit_names=("mxtpu_pp_fwd", "mxtpu_pp_bwd", "mxtpu_pp_upd",
+                       "mxtpu_pp_zeros", "mxtpu_pp_fin", "mxtpu_pp_scale",
+                       "mxtpu_pp_auxsel"))
+
+    # ------------------------------------------------------------- planning
+    def _ensure_plan(self, param_sizes=None):
+        if self._stages is not None:
+            return
+        self._stages = self._low.stage_partition(
+            self._pp, input_names=self._inputs_all, param_sizes=param_sizes)
+        for st in self._stages:
+            for n in list(st.params) + list(st.aux):
+                self._var_stage[n] = st.index
+        has_loss = [False] * self._pp
+        norm_modes = set()
+        for st in self._stages:
+            for n in st.nodes:
+                if not n.is_var and getattr(n.op, "is_loss", False):
+                    has_loss[st.index] = True
+                    norm_modes.add(n.op.normalize_attrs(n.params)
+                                   .get("normalization") or "null")
+        self._stage_has_loss = has_loss
+        if self._micro > 1 and "valid" in norm_modes:
+            raise MXNetError(
+                "pipeline microbatching: a loss head uses "
+                "normalization='valid' — its per-microbatch valid count "
+                "cannot be folded into a constant head-grad scale; use "
+                "'null'/'batch' normalization or num_microbatches=1")
+        if self._micro > 1 and "batch" in norm_modes and len(norm_modes) > 1:
+            raise MXNetError(
+                "pipeline microbatching: loss heads mix 'batch' and "
+                "per-sample normalization — one head-grad scale cannot "
+                "compensate both")
+        # 'batch'-normalized heads divide by the MICROBATCH size, so the
+        # accumulated gradient needs an exact 1/M on the head scale
+        self._micro_comp = (self._micro > 1 and norm_modes == {"batch"})
+
+    def stages(self):
+        """The stage plan (list of executor._Stage; finalised lazily)."""
+        return self._stages
+
+    # ----------------------------------------------------------- placement
+    def _stage_of_var(self, name):
+        if self._stages is None:
+            raise MXNetError(
+                "PipelineTrainStep: call init() or place_params() before "
+                "placing %s — the stage plan is balanced from parameter "
+                "sizes" % name)
+        return self._var_stage[name]
+
+    def param_sharding(self, name):
+        """Replicated NamedSharding on ``name``'s stage sub-mesh."""
+        from jax.sharding import NamedSharding
+        return NamedSharding(self._subs[self._stage_of_var(name)], _pspec())
+
+    def place_params(self, host_params):
+        """Host {name: array} -> per-stage device placement (finalising
+        the stage plan from the real parameter sizes on first use)."""
+        import jax
+        self._ensure_plan({n: int(_np.asarray(v).size)
+                           for n, v in host_params.items()})
+        return {n: jax.device_put(_np.asarray(v), self.param_sharding(n))
+                for n, v in host_params.items()}
+
+    def place_aux(self, host_aux):
+        import jax
+        if self._stages is None:
+            raise MXNetError("PipelineTrainStep: place_params() first")
+        return {n: jax.device_put(_np.asarray(v), self.param_sharding(n))
+                for n, v in host_aux.items()}
+
+    def place_state(self, host_state):
+        """Host optimizer state {name: tuple(arrays)} -> stage placement
+        (replicated mode; ``zero=True`` state is born sharded in init())."""
+        import jax
+        if self.zero:
+            raise MXNetError("PipelineTrainStep(zero=True): optimizer "
+                             "state is born dp-sharded — use init()")
+        if self._stages is None:
+            raise MXNetError("PipelineTrainStep: place_params() first")
+        return {n: tuple(jax.device_put(_np.asarray(s),
+                                        self.param_sharding(n))
+                         for s in st)
+                for n, st in host_state.items()}
+
+    def init(self, data_shapes, label_shapes=None, initializer=None, seed=0):
+        """Infer shapes, initialise params/aux, build optimizer state and
+        place every pytree on its stage's sub-mesh (mirrors
+        ``TrainStep.init``)."""
+        import jax
+        from jax.sharding import NamedSharding
+        params, aux = _host_init(self.symbol, self._low, self.param_names,
+                                 self.aux_names, data_shapes, label_shapes,
+                                 initializer, seed, "PipelineTrainStep")
+        self._ensure_plan({n: int(v.size) for n, v in params.items()})
+        dev_params = {n: jax.device_put(v, self.param_sharding(n))
+                      for n, v in params.items()}
+        dev_aux = {n: jax.device_put(v, self.param_sharding(n))
+                   for n, v in aux.items()}
+        if self.zero:
+            host_state = _zero_state_host(self.fopt, params, self._dp)
+            dev_state = {}
+            for n, st in host_state.items():
+                sh = NamedSharding(self._subs[self._var_stage[n]],
+                                   _pspec("dp"))
+                dev_state[n] = tuple(jax.device_put(s, sh) for s in st)
+        else:
+            dev_state = self.place_state(self.fopt.init_state(params))
+        return dev_params, dev_state, dev_aux
+
+    def shard_batch(self, batch):
+        """Pipeline batches stay on the host: __call__ splits them into
+        microbatches and stages each slice onto its consuming stage's
+        sub-mesh itself (API parity with TrainStep.shard_batch)."""
+        return {k: _np.asarray(v) if not hasattr(v, "devices") else v
+                for k, v in batch.items()}
+
+    def output_sharding(self):
+        """Replicated sharding on the FINAL stage's sub-mesh — where the
+        step's outputs live (fit stages labels here so the metric's
+        same-device lazy reduction engages)."""
+        from jax.sharding import NamedSharding
+        return NamedSharding(self._subs[-1], _pspec())
+
+    # ------------------------------------------------------------ programs
+    def _get_prog(self, kind, stage):
+        """Per-(kind, stage) jitted program; every program traces
+        ``executor._Lowered.run`` (layout/fusion env levers), so the cache
+        keys on ``trace_env_key()`` — toggling e.g. MXNET_STEM_FUSE between
+        steps retraces instead of reusing the stale program (CKEY001)."""
+        env_key = trace_env_key()
+        key = (kind, stage, env_key)
+        fn = self._progs.get(key)
+        if fn is not None:
+            return fn
+        fn = self._build_prog(kind, stage)
+        self._progs[key] = fn
+        self._san_cache.miss({"kind": kind, "stage": stage,
+                              "trace_env": env_key})
+        return fn
+
+    def _carry_spec(self, x, sub):
+        """dp-shard a carried activation's leading (microbatch) axis when
+        it divides, replicate otherwise — the one deterministic boundary
+        interface both the producing constraint and the hand-off
+        device_put use."""
+        dp = int(dict(sub.shape).get("dp", 1))
+        if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] % dp == 0:
+            return _pspec("dp")
+        return _pspec()
+
+    def _build_prog(self, kind, s):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        stage = self._stages[s]
+        sub = self._subs[s]
+        low = self._low
+        dtype = self._dtype
+        label_names = set(self.label_names)
+        rep = NamedSharding(sub, _pspec())
+        micro = self._micro
+
+        def run_fwd(params, aux, carry, extra, rng, scale=None):
+            vals = dict(extra)
+            if dtype is not None:
+                # data inputs cast, labels kept (bfloat16 rounds class
+                # ids); carried activations arrive already in compute
+                # dtype from the previous stage
+                vals = {k: (v.astype(dtype)
+                            if k not in label_names
+                            and v.dtype == _np.float32 else v)
+                        for k, v in vals.items()}
+                params = {k: v.astype(dtype) for k, v in params.items()}
+            vals.update(params)
+            return low.run(vals, aux, rng, True,
+                           no_grad_inputs=self._inputs_all,
+                           head_grad_scale=scale, stage=stage,
+                           carry_vals=list(carry))
+
+        def sub_rng(rng, m):
+            # M=1 keeps the base key so a one-microbatch pipeline matches
+            # the single-program step bit-for-bit through stochastic ops
+            return rng if micro == 1 else jax.random.fold_in(rng, m)
+
+        def carry_pin(x):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(sub, self._carry_spec(x, sub)))
+
+        if kind == "fwd":
+            def fwd(params, aux, carry, extra, rng, m):
+                outs, aux_upd, carry_out = run_fwd(params, aux, carry,
+                                                   extra, sub_rng(rng, m))
+                new_aux = dict(aux)
+                new_aux.update({k: v.astype(aux[k].dtype)
+                                for k, v in aux_upd.items() if k in aux})
+                carry_out = tuple(carry_pin(c) for c in carry_out)
+                if stage.final and self._has_scale:
+                    # the loss surface crosses back f32 under a policy
+                    # (metrics, sentinels) — mirrors TrainStep
+                    outs = tuple(o.astype(jnp.float32) for o in outs)
+                return new_aux, tuple(outs), carry_out
+            fwd.__name__ = "mxtpu_pp_fwd"
+            return jax.jit(fwd)
+
+        if kind == "bwd":
+            # backward = rematerialised stage forward under jax.vjp (the
+            # memory-lean GPipe schedule: only boundary activations are
+            # stashed between the waves); gradients accumulate into the
+            # donated per-stage accumulator
+            scaled = self._stage_has_loss[s] and \
+                (self._has_scale or self._micro_comp)
+            comp = jnp.float32(1.0 / micro) if self._micro_comp else None
+
+            def bwd_core(params, carry, aux, extra, gout, acc, rng, m,
+                         scale):
+                def f(p, c):
+                    outs, _aux, carry_out = run_fwd(p, aux, c, extra,
+                                                    sub_rng(rng, m), scale)
+                    return tuple(carry_out), tuple(outs)
+                (co, outs), vjp_fn = jax.vjp(f, params, tuple(carry))
+                cot = (tuple(gout),
+                       tuple(jnp.ones(o.shape, o.dtype) for o in outs))
+                gp, gc = vjp_fn(cot)
+                new_acc = {n: acc[n] + gp[n].astype(acc[n].dtype)
+                           for n in acc}
+                return gc, new_acc
+
+            if scaled and self._has_scale:
+                def bwd(params, carry, aux, extra, gout, acc, rng, m,
+                        scale):
+                    hs = scale * comp if comp is not None else scale
+                    return bwd_core(params, carry, aux, extra, gout, acc,
+                                    rng, m, hs)
+            elif scaled:
+                def bwd(params, carry, aux, extra, gout, acc, rng, m):
+                    return bwd_core(params, carry, aux, extra, gout, acc,
+                                    rng, m, comp)
+            else:
+                def bwd(params, carry, aux, extra, gout, acc, rng, m):
+                    return bwd_core(params, carry, aux, extra, gout, acc,
+                                    rng, m, None)
+            bwd.__name__ = "mxtpu_pp_bwd"
+            return jax.jit(bwd, donate_argnums=(5,))
+
+        if kind == "zeros":
+            def zeros(params):
+                return {n: jnp.zeros(v.shape, v.dtype)
+                        for n, v in params.items()}
+            zeros.__name__ = "mxtpu_pp_zeros"
+            return jax.jit(zeros, out_shardings=rep)
+
+        if kind == "upd":
+            names = list(stage.params)
+            zero = self.zero
+            dp = self._dp
+            sh_dp = NamedSharding(sub, _pspec("dp"))
+
+            def upd_math(params, grads, opt_state, hyper, t, rng):
+                new_p, new_s = {}, {}
+                for n in names:
+                    g = grads[n].astype(params[n].dtype)
+                    if zero:
+                        gf = jax.lax.with_sharding_constraint(
+                            _flat_shards(g, dp), sh_dp)
+                        wf = jax.lax.with_sharding_constraint(
+                            _flat_shards(params[n], dp), sh_dp)
+                        nwf, new_s[n] = self.fopt.update(
+                            n, wf, gf, opt_state[n], hyper, t, rng=rng)
+                        nw = _from_flat_shards(nwf, params[n].shape)
+                        new_p[n] = jax.lax.with_sharding_constraint(nw, rep)
+                    else:
+                        new_p[n], new_s[n] = self.fopt.update(
+                            n, params[n], g, opt_state[n], hyper, t,
+                            rng=rng)
+                return new_p, new_s
+
+            if self._has_scale:
+                def upd(params, opt_state, acc, hyper, t, rng, finite,
+                        inv):
+                    def do(_):
+                        grads = {n: acc[n] * inv.astype(acc[n].dtype)
+                                 for n in acc}
+                        return upd_math(params, grads, opt_state, hyper,
+                                        t, rng)
+
+                    def skip(_):
+                        # overflow: this stage's weights and optimizer
+                        # state stay put
+                        return params, opt_state
+                    return jax.lax.cond(finite, do, skip, None)
+            else:
+                def upd(params, opt_state, acc, hyper, t, rng):
+                    return upd_math(params, acc, opt_state, hyper, t, rng)
+            upd.__name__ = "mxtpu_pp_upd"
+            state_sh = sh_dp if zero else rep
+            # the lax.cond defeats GSPMD output-sharding propagation —
+            # pin outputs to the carried layout (mirrors TrainStep)
+            return jax.jit(upd, donate_argnums=(0, 1),
+                           out_shardings=(rep, state_sh))
+
+        if kind == "fin":
+            def fin(acc):
+                leaves = jax.tree_util.tree_leaves(acc)
+                if not leaves:      # parameter-less stage (bare loss head)
+                    return jnp.bool_(True)
+                return jnp.stack([jnp.isfinite(g).all()
+                                  for g in leaves]).all()
+            fin.__name__ = "mxtpu_pp_fin"
+            return jax.jit(fin)
+
+        if kind == "scale":
+            policy = self.policy
+
+            def scale_upd(lsc, fins):
+                finite = jnp.stack(list(fins)).all()
+                inv = jnp.float32(1.0) / lsc["scale"]
+                return policy.next_state(lsc, finite), finite, inv
+            scale_upd.__name__ = "mxtpu_pp_scale"
+            return jax.jit(scale_upd, donate_argnums=(0,),
+                           out_shardings=(rep, rep, rep))
+
+        if kind == "auxsel":
+            def auxsel(finite, aux_new, aux_old):
+                # overflow steps must not poison the BN moving stats —
+                # scalar-pred where instead of cond keeps shardings
+                return jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(finite, a, b), aux_new, aux_old)
+            auxsel.__name__ = "mxtpu_pp_auxsel"
+            return jax.jit(auxsel, out_shardings=rep)
+
+        raise MXNetError("unknown pipeline program kind %r" % kind)
+
+    # ------------------------------------------------------------ transfers
+    def _put_carry(self, arrs, s):
+        """Hand a stage-boundary tuple (activations forward, cotangents
+        backward) to stage ``s``'s sub-mesh — the explicit resharding that
+        makes the runtime insert the device-to-device transfers."""
+        import jax
+        from jax.sharding import NamedSharding
+        sub = self._subs[s]
+        return tuple(jax.device_put(
+            a, NamedSharding(sub, self._carry_spec(a, sub)))
+            for a in arrs)
+
+    def _put_batch(self, host, s):
+        import jax
+        from jax.sharding import NamedSharding
+        sub = self._subs[s]
+        return jax.device_put(host,
+                              NamedSharding(sub, self._carry_spec(host,
+                                                                  sub)))
+
+    # ------------------------------------------------------------ loss scale
+    def _scale_state_dev(self):
+        """Loss-scale state, living replicated on the FINAL stage's
+        sub-mesh (where the loss heads are); donated into every step's
+        scale-update program."""
+        if self._scale_state is not None:
+            return self._scale_state
+        import jax
+        from jax.sharding import NamedSharding
+        dst = NamedSharding(self._subs[-1], _pspec())
+        self._scale_state = {k: jax.device_put(v, dst)
+                             for k, v in self.policy.init_state().items()}
+        return self._scale_state
+
+    def amp_stats(self):
+        """(scale, overflow_delta) — two-scalar sync; telemetry-gated
+        callers only (mirrors TrainStep.amp_stats)."""
+        if not self._has_scale or self._scale_state is None:
+            return None
+        import jax
+        with _san.allow_sync("amp loss-scale telemetry"):
+            host = jax.device_get(self._scale_state)
+        total = int(host["overflow"])
+        delta = total - self._overflow_seen
+        self._overflow_seen = total
+        return float(host["scale"]), delta
+
+    def _donate_pairs(self, args):
+        """Labelled leaves of the donated pytrees (params, opt_state[,
+        loss-scale state]) for the mxsan DONATE ledger.  aux is NOT
+        donated on the pipeline path (the overflow select needs the
+        pre-step values)."""
+        import jax
+        for name, tree in zip(("params", "opt_state", "loss_scale_state"),
+                              args):
+            for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+                yield name + jax.tree_util.keystr(path), leaf
+
+    def _timed(self, busy, s, fn, *args):
+        """Run one stage program; with telemetry on, block and charge the
+        device time to stage ``s`` (the pp.stage spans / per-stage skew
+        source — measurement serialises the schedule, exactly like the
+        executor's telemetry-mode device syncs)."""
+        if busy is None:
+            return fn(*args)
+        import jax
+        import time as _time
+        t0 = _time.perf_counter()
+        out = fn(*args)
+        with _san.allow_sync("pipeline stage telemetry timing"):
+            jax.block_until_ready(out)
+        busy[s] += _time.perf_counter() - t0
+        return out
+
+    # ------------------------------------------------------------------ call
+    def __call__(self, params, opt_state, aux, batch, rng=None):
+        """One pipelined, microbatched global step.  Returns
+        (params, opt_state, aux, outputs) — outputs are the loss heads
+        over the full global batch (microbatch results concatenated in
+        order)."""
+        import jax
+        import time as _time
+        from jax.sharding import NamedSharding
+        from . import profiler as _profiler
+        from . import telemetry as _tel
+        from . import diagnostics as _diag
+        if self._stages is None:
+            raise MXNetError(
+                "PipelineTrainStep: call init() (or place_params/"
+                "place_state/place_aux) before stepping")
+        if rng is None:
+            rng = _random.next_key()
+        M, S = self._micro, self._pp
+        for n in self.data_names + self.label_names:
+            if n not in batch:
+                raise MXNetError("pipeline step: missing input %s" % n)
+        b0 = batch[self.data_names[0]].shape[0]
+        if b0 % M:
+            raise MXNetError(
+                "pipeline step: global batch %d is not divisible by "
+                "num_microbatches=%d" % (b0, M))
+        mb = b0 // M
+        if mb % self._dp:
+            raise MXNetError(
+                "pipeline step: microbatch %d (batch %d / M=%d) is not "
+                "divisible by dp=%d" % (mb, b0, M, self._dp))
+        hyper = self.fopt.hyper(self.num_update)
+        self.num_update += 1
+        t = _np.int32(self.num_update)
+        telem = _tel._enabled
+        busy = [0.0] * S if telem else None
+        wall0 = _time.time() if telem else 0.0
+        t0 = _time.perf_counter() if telem else 0.0
+        args_led = (params, opt_state) + \
+            ((self._scale_state_dev(),) if self._has_scale else ())
+        if _san._donate_on:
+            _san.check_donated("pipeline_step", self._donate_pairs(args_led))
+        with _profiler.Scope("pipeline_step[%d]" % self.num_update,
+                             "symbolic"), \
+                _san.hot_region("pipeline_step"):
+            rep_rngs = [jax.device_put(rng, NamedSharding(sub, _pspec()))
+                        for sub in self._subs]
+            p_s = [{n: params[n] for n in st.params} for st in self._stages]
+            st_s = [{n: opt_state[n] for n in st.params}
+                    for st in self._stages]
+            aux_s = [{n: aux[n] for n in st.aux} for st in self._stages]
+            aux_pre = [dict(a) for a in aux_s] if self._has_scale else None
+            acc = [self._timed(busy, s, self._get_prog("zeros", s), p_s[s])
+                   for s in range(S)]
+            # ---- forward wave: microbatch m enters stage s as soon as
+            # (m, s-1) and (m-1, s) are dispatched; stages live on
+            # disjoint device slices, so async dispatch realises the
+            # fill/steady/drain overlap
+            stash = [[None] * S for _ in range(M)]   # boundary activations
+            outs_m = [None] * M
+            for m in range(M):
+                c = ()
+                for s in range(S):
+                    st = self._stages[s]
+                    ex = {n: self._put_batch(batch[n][m * mb:(m + 1) * mb],
+                                             s)
+                          for n in st.inputs}
+                    cin = self._put_carry(c, s)
+                    stash[m][s] = (cin, ex)
+                    aux_new, o, c = self._timed(
+                        busy, s, self._get_prog("fwd", s),
+                        p_s[s], aux_s[s], cin, ex, rep_rngs[s],
+                        _np.int32(m))
+                    aux_s[s] = aux_new
+                outs_m[m] = o
+            # ---- backward wave (reverse order; per-stage accumulators
+            # donated through the wave)
+            scale_s = {}
+            if self._has_scale:
+                # one scale transfer per loss-bearing stage (it cannot
+                # change during the wave), not one per microbatch
+                scale_op = self._scale_state["scale"]
+                scale_s = {s: (scale_op if s == S - 1 else
+                               self._put_carry((scale_op,), s)[0])
+                           for s in range(S) if self._stage_has_loss[s]}
+            for m in reversed(range(M)):
+                g = ()
+                for s in reversed(range(S)):
+                    cin, ex = stash[m][s]
+                    gout = self._put_carry(g, s)
+                    call = [p_s[s], cin, aux_s[s], ex, gout, acc[s],
+                            rep_rngs[s], _np.int32(m)]
+                    if s in scale_s:
+                        call.append(scale_s[s])
+                    g, acc[s] = self._timed(busy, s,
+                                            self._get_prog("bwd", s), *call)
+                stash[m] = None   # free this microbatch's boundary stash
+            # ---- loss-scale automaton + combined finite flag, on device
+            fin_s = inv_s = None
+            if self._has_scale:
+                fins = [self._timed(busy, s, self._get_prog("fin", s),
+                                    acc[s]) for s in range(S)]
+                last = NamedSharding(self._subs[-1], _pspec())
+                fins_dev = tuple(jax.device_put(f, last) for f in fins)
+                new_lsc, finite, inv = self._timed(
+                    busy, S - 1, self._get_prog("scale", S - 1),
+                    self._scale_state, fins_dev)
+                self._scale_state = new_lsc
+                fin_s = [self._put_carry((finite,), s)[0]
+                         for s in range(S)]
+                inv_s = [self._put_carry((inv,), s)[0] for s in range(S)]
+            # ---- per-stage optimizer update (ZeRO-1 shards over the
+            # stage sub-mesh's dp axis); donated params/state
+            new_params, new_state, new_aux = {}, {}, {}
+            for s in range(S):
+                call = [p_s[s], st_s[s], acc[s], hyper, t, rep_rngs[s]]
+                if self._has_scale:
+                    call += [fin_s[s], inv_s[s]]
+                np_s, ns_s = self._timed(busy, s,
+                                         self._get_prog("upd", s), *call)
+                a_s = aux_s[s]
+                if self._has_scale and self._stages[s].aux:
+                    a_s = self._timed(busy, s,
+                                      self._get_prog("auxsel", s),
+                                      fin_s[s], a_s, aux_pre[s])
+                new_params.update(np_s)
+                new_state.update(ns_s)
+                new_aux.update(a_s)
+            if M == 1:
+                outs = tuple(outs_m[0])
+            else:
+                import jax.numpy as jnp
+                outs = tuple(jnp.concatenate([om[i] for om in outs_m],
+                                             axis=0)
+                             for i in range(len(outs_m[0])))
+        if _san._donate_on:
+            _san.note_donated("pipeline_step",
+                              self._donate_pairs(args_led),
+                              step=self.num_update)
+        if telem:
+            frac = pipeline_bubble_fraction(S, M)
+            for s in range(S):
+                _tel.record_span("pp.stage", wall0, busy[s],
+                                 cat="pipeline", stage=s, microbatches=M)
+            wall = _time.perf_counter() - t0
+            _tel.record_span("pp.bubble", wall0, wall * frac,
+                             cat="pipeline", pp=S, microbatches=M)
+            _tel.gauge("pp_bubble_fraction", frac)
+            for s in range(S):
+                st = self._stages[s]
+                nb = sum(_tel.nbytes_of(new_params[n]) for n in st.params)
+                nb += sum(_tel.nbytes_of(x) for n in st.params
+                          for x in new_state[n])
+                nb += sum(_tel.nbytes_of(new_aux[n]) for n in st.aux)
+                # stage in the NAME: the gauge registry (and everything
+                # reading it — /metrics, summaries, the fleet merge) is
+                # name-keyed last-write-wins, so a tagged single name
+                # would surface only the final stage's footprint
+                _tel.gauge("pp_stage%d_live_bytes" % s, nb, stage=s)
+            if self._has_scale and self._amp_emit \
+                    and _tel.scalar_due(self.num_update):
+                scale_v, overflow = self.amp_stats()
+                _tel.gauge("loss_scale", scale_v)
+                if overflow:
+                    _tel.counter("amp_overflow_steps", overflow)
+        if _diag._armed:
+            _diag.heartbeat(pipeline_step=self.num_update)
+        mode = _diag.check_numerics_mode() if self.check_numerics else None
+        if mode is not None:
+            _diag.check_outputs(outs, mode, where="pipeline_step",
+                                num_update=self.num_update)
+        return new_params, new_state, new_aux, outs

@@ -55,6 +55,9 @@ CACHES = (
     {"name": "TrainStep._multi_cache",
      "key": ("mxnet_tpu/train.py", "TrainStep.run_steps"),
      "roots": (("mxnet_tpu/executor.py", "_Lowered.run"),)},
+    {"name": "PipelineTrainStep._progs",
+     "key": ("mxnet_tpu/train.py", "PipelineTrainStep._get_prog"),
+     "roots": (("mxnet_tpu/executor.py", "_Lowered.run"),)},
     {"name": "serving bucket-rung ladder",
      "key": ("mxnet_tpu/serving.py", "ServedModel._predictor"),
      "roots": ()},     # rung jits land in the executor cache (see above)

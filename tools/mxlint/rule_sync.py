@@ -31,7 +31,8 @@ HOT_PATHS = {
         "DataParallelExecutorGroup.forward",
         "DataParallelExecutorGroup.backward"),
     "mxnet_tpu/executor.py": ("Executor.forward", "Executor.backward"),
-    "mxnet_tpu/train.py": ("TrainStep.__call__", "EvalStep.__call__"),
+    "mxnet_tpu/train.py": ("TrainStep.__call__", "EvalStep.__call__",
+                           "PipelineTrainStep.__call__"),
     # PR 7/8 hot paths (predating mxlint): the serving batcher's tick —
     # one coalesced forward per tick, its only legitimate d2h transfer
     # is the row scatter — and the device-prefetch producer thread,

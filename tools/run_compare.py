@@ -46,7 +46,11 @@ import sys
 _UP_HINTS = ("acc", "f1", "per_sec", "throughput", "reward", "top",
              "qps", "speedup")
 _DOWN_HINTS = ("loss", "entropy", "err", "perplexity", "mae", "mse",
-               "rmse", "time", "wait", "p50", "p90", "p99", "latency")
+               "rmse", "time", "wait", "p50", "p90", "p99", "latency",
+               # pipeline-parallel ladder metrics: the fill/drain bubble
+               # share and the per-stage memory footprint both regress by
+               # going UP (docs/distributed.md "Pipeline parallelism")
+               "bubble", "stage_param", "stage_mem", "live_bytes")
 
 _EVENT_TYPES = ("scalar", "span", "counter", "gauge", "hist", "summary")
 
@@ -135,6 +139,15 @@ def _load_bench(run, doc, path):
     serving = rec.get("serving") if isinstance(rec, dict) else None
     if isinstance(serving, dict):
         for k, v in serving.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                run.bench[str(k)] = float(v)
+    # pipeline record (dryrun_multichip's pp ladder / a pipelined bench):
+    # numeric fields are gated headline metrics — pp_bubble_fraction and
+    # the per-stage memory fields regress by going up (direction hints);
+    # the nested config block (pp/dp/microbatch identity) is not compared
+    pipeline = rec.get("pipeline") if isinstance(rec, dict) else None
+    if isinstance(pipeline, dict):
+        for k, v in pipeline.items():
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 run.bench[str(k)] = float(v)
     chained = (run.meta or {}).get("telemetry_scalars")

@@ -107,11 +107,18 @@ def fold_rank(events):
             has_summary = True
             break
     span_durs = defaultdict(list)
+    stage_durs = defaultdict(list)
     hist_vals = defaultdict(list)
     for ev in events:
         t = ev.get("type")
         if t == "span":
             span_durs[ev["name"]].append(ev.get("dur", 0.0))
+            # pipeline stage spans additionally fold by their stage tag —
+            # the per-STAGE skew view (the pp analogue of per-rank skew)
+            if ev["name"] == "pp.stage" and \
+                    (ev.get("tags") or {}).get("stage") is not None:
+                stage_durs[str(ev["tags"]["stage"])].append(
+                    ev.get("dur", 0.0))
         elif not has_summary:
             if t == "counter":
                 counters[ev["name"]] = ev.get("total", 0)
@@ -129,7 +136,8 @@ def fold_rank(events):
                  ((n, rebuild_hist(vs)) for n, vs in hist_vals.items())
                  if h is not None}
     return {"counters": counters, "gauges": gauges, "histograms": hists,
-            "span_durs": dict(span_durs), "has_summary": has_summary}
+            "span_durs": dict(span_durs), "stage_durs": dict(stage_durs),
+            "has_summary": has_summary}
 
 
 # ------------------------------------------------------- histogram rebuild
@@ -306,6 +314,40 @@ def straggler_report(per_rank, names=SKEW_SPANS, ratio=STRAGGLER_RATIO):
     return report
 
 
+def stage_skew_report(per_rank, ratio=STRAGGLER_RATIO):
+    """Pipeline per-STAGE skew from the ``pp.stage`` spans (stage-tagged
+    per-step busy time, mxnet_tpu/train.py PipelineTrainStep): durations
+    merged across ranks per stage, the slowest stage by mean, and the skew
+    ratio vs the median of the other stages — naming the stage the
+    schedule's bubbles wait for, the way the per-rank view names straggler
+    ranks.  Empty dict when no pipeline spans exist."""
+    merged = defaultdict(list)
+    for st in per_rank.values():
+        for stage, durs in st.get("stage_durs", {}).items():
+            merged[stage].extend(durs)
+    if not merged:
+        return {}
+    table = {}
+    for stage in sorted(merged, key=lambda s: (len(s), s)):
+        durs = merged[stage]
+        table[stage] = {"count": len(durs),
+                        "mean": sum(durs) / len(durs),
+                        "p50": percentile(durs, 0.50),
+                        "p99": percentile(durs, 0.99)}
+    means = sorted((rec["mean"], stage) for stage, rec in table.items())
+    slowest_mean, slowest_stage = means[-1]
+    rest = [m for m, _ in means[:-1]] or [slowest_mean]
+    median_mean = percentile(rest, 0.5)
+    skew = slowest_mean / median_mean if median_mean else float("inf")
+    return {
+        "stages": table,
+        "slowest_stage": slowest_stage,
+        "skew_ratio": skew,
+        "slow_stage": slowest_stage if (len(table) >= 2 and skew >= ratio)
+        else None,
+    }
+
+
 # ----------------------------------------------------------------- top level
 def aggregate(paths, skew_spans=SKEW_SPANS, ratio=STRAGGLER_RATIO):
     """Load + merge a set of per-rank files.  Files without a rank suffix
@@ -323,6 +365,7 @@ def aggregate(paths, skew_spans=SKEW_SPANS, ratio=STRAGGLER_RATIO):
     merged["ranks"] = sorted(per_rank)
     merged["skew"] = straggler_report(per_rank, names=skew_spans,
                                       ratio=ratio)
+    merged["stage_skew"] = stage_skew_report(per_rank, ratio=ratio)
     merged["per_rank"] = per_rank
     return merged
 
@@ -366,6 +409,21 @@ def render(agg, out=sys.stdout):
         out.write("  slowest rank: %s (%.2fx the median of the other "
                   "ranks) — %s\n"
                   % (rep["slowest_rank"], rep["skew_ratio"], verdict))
+
+    stage = agg.get("stage_skew")
+    if stage:
+        out.write("\nPer-stage skew — pipeline 'pp.stage' busy time\n")
+        out.write("%6s %8s %10s %10s %10s\n"
+                  % ("stage", "n", "mean_ms", "p50_ms", "p99_ms"))
+        for sname in sorted(stage["stages"], key=lambda s: (len(s), s)):
+            rec = stage["stages"][sname]
+            out.write("%6s %8d %10.3f %10.3f %10.3f\n"
+                      % (sname, rec["count"], rec["mean"] / _US_PER_MS,
+                         rec["p50"] / _US_PER_MS, rec["p99"] / _US_PER_MS))
+        verdict = "SLOW STAGE" if stage["slow_stage"] is not None else "ok"
+        out.write("  slowest stage: %s (%.2fx the median of the other "
+                  "stages) — %s\n"
+                  % (stage["slowest_stage"], stage["skew_ratio"], verdict))
 
     counters = agg["counters"]
     if counters:
