@@ -48,6 +48,7 @@ from . import profiler
 from . import engine
 from . import predictor
 from . import serving
+from . import checkpoint
 from . import rtc
 from .predictor import Predictor
 from . import rnn

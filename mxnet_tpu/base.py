@@ -11,7 +11,8 @@ import os
 import threading
 
 __all__ = ["MXNetError", "string_types", "numeric_types", "get_env", "check",
-           "Registry", "classproperty", "TRACE_ENV_DEFAULTS", "trace_env_key"]
+           "Registry", "classproperty", "TRACE_ENV_DEFAULTS", "trace_env_key",
+           "atomic_write"]
 
 string_types = (str,)
 numeric_types = (float, int)
@@ -58,6 +59,62 @@ TRACE_ENV_DEFAULTS = (
 def trace_env_key():
     """Snapshot of the trace-affecting env flags, for jit cache keys."""
     return tuple(get_env(n, d) for n, d in TRACE_ENV_DEFAULTS)
+
+
+class atomic_write(object):
+    """Crash-consistent local file write: bytes land in a same-directory
+    temp file, are flushed + fsynced, then atomically renamed over the
+    target — a process killed mid-write leaves the previous file intact
+    and never exposes a truncated one (the checkpoint durability
+    contract, docs/elastic.md).  Context manager yielding the open file;
+    on error the temp file is removed and the target untouched."""
+
+    def __init__(self, fname, mode="wb", fsync=True):
+        self.fname = str(fname)
+        self.tmp = "%s.tmp-%d" % (self.fname, os.getpid())
+        self.mode = mode
+        self.fsync = fsync
+        self._f = None
+
+    def __enter__(self):
+        self._f = open(self.tmp, self.mode)
+        return self._f
+
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            try:
+                if exc_type is None:
+                    self._f.flush()
+                    if self.fsync:
+                        os.fsync(self._f.fileno())
+            finally:
+                # close unconditionally: a failed fsync (ENOSPC) must not
+                # leak the descriptor — full-disk checkpointing retries
+                # would otherwise march the process to EMFILE
+                self._f.close()
+            if exc_type is None:
+                os.replace(self.tmp, self.fname)
+                # the rename itself lives in the directory: without a
+                # dir fsync a power cut can drop the entry even though
+                # the save reported success (the durability half of the
+                # crash-consistency contract)
+                d = os.path.dirname(self.fname) or "."
+                try:
+                    fd = os.open(d, os.O_RDONLY)
+                    try:
+                        os.fsync(fd)
+                    finally:
+                        os.close(fd)
+                except OSError:
+                    pass   # platform without directory fsync
+                return False
+        finally:
+            if os.path.exists(self.tmp):
+                try:
+                    os.remove(self.tmp)
+                except OSError:
+                    pass
+        return False
 
 
 def smart_open(uri, mode="rb"):

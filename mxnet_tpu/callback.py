@@ -18,8 +18,8 @@ import time
 
 from . import telemetry as _tel
 
-__all__ = ["do_checkpoint", "module_checkpoint", "log_train_metric",
-           "Speedometer", "ProgressBar"]
+__all__ = ["do_checkpoint", "module_checkpoint", "do_step_checkpoint",
+           "log_train_metric", "Speedometer", "ProgressBar"]
 
 _LOG = logging.getLogger(__name__)
 
@@ -58,6 +58,45 @@ def do_checkpoint(prefix, period=1):
             save_checkpoint(prefix, done, sym, arg, aux)
 
     return save_params
+
+
+def do_step_checkpoint(module, checkpointer, every_n_steps, resume_epoch=0,
+                       nbatch_offset=0):
+    """Batch-end callback: every ``every_n_steps`` optimizer updates,
+    write a sharded asynchronous checkpoint of the live fused training
+    state (``checkpoint.Checkpointer``) — the elastic-v2 step-interval
+    cadence (``MXNET_CKPT_EVERY_N_STEPS``; docs/elastic.md).
+
+    ``nbatch_offset`` corrects the recorded in-epoch batch index on a
+    mid-epoch resume: the fit loop's ``nbatch`` restarts at 0 after the
+    already-consumed batches were skipped, but the manifest must carry
+    the TRUE data position or a second resume would double-skip.
+
+    Needs ``Module.fit``'s fused fast path (the live pytrees + shard
+    topology live there); on the general executor path it warns once and
+    the per-epoch monolithic checkpoints remain the recovery points."""
+    every = max(1, int(every_n_steps))
+    state = {"warned": False, "last": -1}
+
+    def save_step(param):
+        ff = getattr(module, "_active_fused", None)
+        if ff is None:
+            if not state["warned"]:
+                state["warned"] = True
+                _LOG.warning(
+                    "step checkpointing: the fused fit path is not active "
+                    "— mid-epoch sharded checkpoints are skipped (per-"
+                    "epoch checkpoints still run)")
+            return
+        step = ff.num_update()
+        if step % every or step == state["last"]:
+            return
+        state["last"] = step
+        nbatch = param.nbatch + (nbatch_offset
+                                 if param.epoch == resume_epoch else 0)
+        ff.save_checkpoint(checkpointer, epoch=param.epoch, nbatch=nbatch)
+
+    return save_step
 
 
 def log_train_metric(period, auto_reset=False):

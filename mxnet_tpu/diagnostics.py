@@ -513,9 +513,16 @@ def sample_device_memory(**tags):
     _tel.gauge("device_live_arrays", count, **tags)
     for d, nb in sorted(per_dev.items()):
         _tel.gauge("device_live_bytes[%s]" % d, nb, **tags)
-    for d in jax.devices():
+    for d in jax.local_devices():
+        # local_devices, not devices: under a multi-process world the
+        # remote devices are non-addressable and memory_stats() raises
+        # (INVALID_ARGUMENT) — each rank reports its own devices, the
+        # fleet merge composes them
         stats = getattr(d, "memory_stats", None)
-        stats = stats() if callable(stats) else None
+        try:
+            stats = stats() if callable(stats) else None
+        except Exception:
+            stats = None   # backend without memory introspection
         if stats and "bytes_in_use" in stats:
             _tel.gauge("device_bytes_in_use[%s]" % d,
                        int(stats["bytes_in_use"]), **tags)

@@ -201,7 +201,8 @@ class KVStore(object):
     def save_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("Cannot save states for distributed training")
-        with open(fname, "wb") as fout:
+        from .base import atomic_write
+        with atomic_write(fname) as fout:
             fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):

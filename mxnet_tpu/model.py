@@ -103,7 +103,13 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     """Save `prefix-symbol.json` + `prefix-%04d.params` (parity:
-    model.save_checkpoint; format per SURVEY.md §5.4)."""
+    model.save_checkpoint; format per SURVEY.md §5.4).
+
+    Crash-consistent: both files are written via temp + fsync + atomic
+    rename (``base.atomic_write`` inside ``Symbol.save``/``nd.save``), so
+    a kill mid-write leaves the previous epoch's files intact and
+    ``elastic.latest_checkpoint`` (which additionally validates the file
+    framing) never resumes from a torn checkpoint — docs/elastic.md."""
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}

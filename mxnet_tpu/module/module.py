@@ -373,12 +373,14 @@ class Module(BaseModule):
         self._params_dirty = False
 
     def save_optimizer_states(self, fname):
-        """(parity: module.py:674-704)"""
+        """(parity: module.py:674-704; crash-consistent: temp + atomic
+        rename, like every checkpoint artifact — docs/elastic.md)"""
         assert self.optimizer_initialized
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
+            from ..base import atomic_write
+            with atomic_write(fname) as fout:
                 fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
@@ -603,6 +605,54 @@ class _FusedFit(object):
                          for n in self._ts.aux_names}
         names = module._data_names + module._label_names
         self._input_names = names
+        resume = getattr(module, "_ckpt_resume", None)
+        if resume is not None:
+            # elastic-v2 resume hook (parallel/elastic.py sets the path):
+            # restore the full training state — parameters, optimizer
+            # state re-sharded onto THIS topology, loss-scale automaton,
+            # exact update count — over the placement done above.  The
+            # checkpoint may have been written under a different pp/dp
+            # topology; restore_into reassembles and re-shards.
+            module._ckpt_resume = None
+            from .. import checkpoint as _ckpt
+            if isinstance(resume, dict):
+                # elastic stashes the one load_sharded it already did
+                self._params, self._state, self._aux, _man = \
+                    _ckpt.restore_loaded(
+                        self._ts, resume["man"], resume["params"],
+                        resume["opt_state"], resume["aux"],
+                        device=None if self._pipeline else self._dev,
+                        where=resume["path"])
+            else:
+                self._params, self._state, self._aux, _man = \
+                    _ckpt.restore_into(self._ts, resume,
+                                       device=None if self._pipeline
+                                       else self._dev)
+            # the optimizer's own counters must agree with the restored
+            # step (lr schedules, Adam bias correction continue exactly)
+            if hasattr(opt, "_index_update_count"):
+                for idx in range(len(self._ts.param_names)):
+                    opt._index_update_count[idx] = self._ts.num_update
+            if hasattr(opt, "num_update"):
+                opt.num_update = max(getattr(opt, "num_update", 0),
+                                     self._ts.num_update)
+
+    # ---------------------------------------------------- checkpoint hooks
+    def num_update(self):
+        """The live global update count (the step axis of the elastic-v2
+        step-interval checkpoint cadence)."""
+        return self._ts.num_update
+
+    def save_checkpoint(self, checkpointer, epoch=0, nbatch=0, extra=None):
+        """Snapshot the LIVE fused training state through the sharded
+        (async) checkpoint writer — params/optimizer state/aux plus the
+        step's shard topology (pp stage partition, ZeRO layout) so each
+        ownership group lands in its own shard file.  The snapshot is a
+        host fetch; serialisation and fsync overlap training on the
+        writer thread (mxnet_tpu/checkpoint.py)."""
+        return checkpointer.save(self._ts, self._params, self._state,
+                                 self._aux, epoch=epoch, nbatch=nbatch,
+                                 extra=extra)
 
     def _updater(self):
         mod = self._mod

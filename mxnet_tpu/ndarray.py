@@ -546,29 +546,133 @@ def _code_to_dtype(code):
     return _CODE_DTYPE[code]
 
 
+def _write_entry(f, name, arr):
+    """One named entry in the ``.params`` framing (the single writer both
+    serializers share — the format exists in exactly one place)."""
+    npv = np.asarray(arr.value if isinstance(arr, NDArray) else arr)
+    nb = name.encode("utf-8")
+    f.write(struct.pack("<I", len(nb)))
+    f.write(nb)
+    f.write(struct.pack("<I", _dtype_to_code(npv.dtype)))
+    f.write(struct.pack("<I", npv.ndim))
+    f.write(struct.pack("<%dq" % npv.ndim, *npv.shape))
+    f.write(npv.tobytes())
+
+
+def _read_entries(f, where):
+    """Yield ``(name, numpy array)`` per entry — the single reader under
+    :func:`load`, :func:`load_arrays` and :func:`deserialize_arrays`."""
+    magic, _ = struct.unpack("<QQ", f.read(16))
+    if magic != _MAGIC:
+        raise MXNetError("invalid NDArray file format: %s" % (where,))
+    n = struct.unpack("<Q", f.read(8))[0]
+    for _ in range(n):
+        ln = struct.unpack("<I", f.read(4))[0]
+        name = f.read(ln).decode("utf-8")
+        code = struct.unpack("<I", f.read(4))[0]
+        ndim = struct.unpack("<I", f.read(4))[0]
+        shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) \
+            if ndim else ()
+        dt = _code_to_dtype(code)
+        count = int(np.prod(shape)) if shape else 1
+        buf = f.read(count * dt.itemsize)
+        if len(buf) < count * dt.itemsize:
+            raise MXNetError("truncated NDArray file: %s" % (where,))
+        yield name, np.frombuffer(buf, dtype=dt).reshape(shape).copy()
+
+
+def serialize_arrays(data):
+    """Serialize ``{name: array}`` (NDArray or host numpy values) to the
+    ``.params`` byte format — the in-memory half of :func:`save`, shared
+    with the sharded checkpoint writer (mxnet_tpu/checkpoint.py), whose
+    writer thread must never touch devices."""
+    import io as _io
+    f = _io.BytesIO()
+    f.write(struct.pack("<QQ", _MAGIC, 0))
+    f.write(struct.pack("<Q", len(data)))
+    for name, arr in data.items():
+        _write_entry(f, name, arr)
+    return f.getvalue()
+
+
 def save(fname, data):
     """Save list/dict of NDArrays (parity: mx.nd.save, the .params format;
     reference src/ndarray/ndarray.cc:652-686).  Binary format is magic-framed
-    like the reference but not byte-compatible (no mshadow blobs on TPU)."""
+    like the reference but not byte-compatible (no mshadow blobs on TPU).
+
+    Local files are written CRASH-CONSISTENTLY: entries stream into a
+    same-dir temp file (no whole-file staging buffer — a 10 GB model
+    costs no extra 10 GB of host memory), which is fsynced and atomically
+    renamed over ``fname`` — a checkpoint killed mid-write leaves the
+    previous file intact instead of a truncated one (docs/elastic.md).
+    Remote URIs stream as before (object stores publish on close)."""
     if isinstance(data, dict):
-        names, arrays = list(data.keys()), list(data.values())
+        items = list(data.items())
     else:
-        names, arrays = [""] * len(data), list(data)
+        arrays = list(data)
         if not all(isinstance(a, NDArray) for a in arrays):
             raise MXNetError("save only supports NDArray contents")
-    from .base import smart_open
-    with smart_open(fname, "wb") as f:
+        items = [("", a) for a in arrays]
+
+    def stream(f):
         f.write(struct.pack("<QQ", _MAGIC, 0))
-        f.write(struct.pack("<Q", len(arrays)))
-        for name, arr in zip(names, arrays):
-            npv = np.asarray(arr.value)
-            nb = name.encode("utf-8")
-            f.write(struct.pack("<I", len(nb)))
-            f.write(nb)
-            f.write(struct.pack("<I", _dtype_to_code(arr.dtype)))
-            f.write(struct.pack("<I", npv.ndim))
-            f.write(struct.pack("<%dq" % npv.ndim, *npv.shape))
-            f.write(npv.tobytes())
+        f.write(struct.pack("<Q", len(items)))
+        for name, arr in items:
+            _write_entry(f, name, arr)
+
+    if "://" in str(fname):
+        from .base import smart_open
+        with smart_open(fname, "wb") as f:
+            stream(f)
+    else:
+        from .base import atomic_write
+        with atomic_write(fname) as f:
+            stream(f)
+
+
+def validate_file(fname):
+    """True when ``fname`` is a structurally complete ``.params`` file:
+    magic ok and every entry's framing + payload fits the file (walked
+    with seeks — no array data is read).  A truncated or garbage file
+    returns False; ``elastic.latest_checkpoint`` uses this to skip
+    half-written candidates instead of resuming from them."""
+    try:
+        with open(fname, "rb") as f:
+            f.seek(0, 2)
+            total = f.tell()
+            f.seek(0)
+            head = f.read(24)
+            if len(head) < 24:
+                return False
+            magic, _, n = struct.unpack("<QQQ", head)
+            if magic != _MAGIC:
+                return False
+            for _ in range(n):
+                b = f.read(4)
+                if len(b) < 4:
+                    return False
+                ln = struct.unpack("<I", b)[0]
+                b = f.read(ln + 8)
+                if len(b) < ln + 8:
+                    return False
+                code, ndim = struct.unpack("<II", b[ln:])
+                b = f.read(8 * ndim)
+                if len(b) < 8 * ndim:
+                    return False
+                shape = struct.unpack("<%dq" % ndim, b) if ndim else ()
+                try:
+                    dt = _code_to_dtype(code)
+                except Exception:
+                    return False
+                count = int(np.prod(shape)) if shape else 1
+                nbytes = count * dt.itemsize
+                end = f.tell() + nbytes
+                if end > total:
+                    return False
+                f.seek(end)
+            return f.tell() <= total
+    except OSError:
+        return False
 
 
 def save_raw_bytes(arr):
@@ -599,27 +703,32 @@ def load_from_raw_bytes(buf):
     return array(npv.reshape(shape), dtype=dt)
 
 
+def load_arrays(fname):
+    """Load a ``.params`` file as ``{name: numpy array}`` WITHOUT staging
+    anything onto a device — the host-side loader the checkpoint restore
+    path reassembles shards with (placement happens once, after
+    reassembly, via the step's ``place_checkpoint``)."""
+    from .base import smart_open
+    with smart_open(fname, "rb") as f:
+        return dict(_read_entries(f, fname))
+
+
+def deserialize_arrays(blob):
+    """Inverse of :func:`serialize_arrays` over in-memory bytes (the
+    checkpoint loader hashes a shard's bytes and parses the same buffer —
+    one disk read, not two)."""
+    import io as _io
+    return dict(_read_entries(_io.BytesIO(blob), "<bytes>"))
+
+
 def load(fname):
     """Load NDArrays saved by :func:`save` (parity: mx.nd.load)."""
     from .base import smart_open
+    names, arrays = [], []
     with smart_open(fname, "rb") as f:
-        magic, _ = struct.unpack("<QQ", f.read(16))
-        if magic != _MAGIC:
-            raise MXNetError("invalid NDArray file format")
-        n = struct.unpack("<Q", f.read(8))[0]
-        names, arrays = [], []
-        for _ in range(n):
-            ln = struct.unpack("<I", f.read(4))[0]
-            name = f.read(ln).decode("utf-8")
-            code = struct.unpack("<I", f.read(4))[0]
-            ndim = struct.unpack("<I", f.read(4))[0]
-            shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim else ()
-            dt = _code_to_dtype(code)
-            count = int(np.prod(shape)) if shape else 1
-            buf = f.read(count * dt.itemsize)
-            npv = np.frombuffer(buf, dtype=dt).reshape(shape)
+        for name, npv in _read_entries(f, fname):
             names.append(name)
-            arrays.append(array(npv, dtype=dt))
+            arrays.append(array(npv, dtype=npv.dtype))
     if any(names):
         return dict(zip(names, arrays))
     return arrays

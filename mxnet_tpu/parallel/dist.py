@@ -26,10 +26,11 @@ hot-state model is intentionally replaced by checkpointing).
 from __future__ import annotations
 
 import os
+import threading
 
 import numpy as _np
 
-from ..base import get_env
+from ..base import MXNetError, get_env
 
 _initialized = False
 
@@ -85,6 +86,41 @@ def barrier(name="kvstore"):
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(name)
+
+
+def coordination_barrier(name, timeout_ms=600000):
+    """Process barrier over the coordination SERVICE (key-value RPC, no
+    device collectives).  ``barrier``/``sync_global_devices`` launches a
+    psum over all global devices, so calling it off the main thread can
+    interleave with in-flight training collectives and deadlock the world
+    — this variant is safe from any thread (the async checkpoint writer
+    meets its peers here).  ``name`` must be unique per use within one
+    coordination-service lifetime."""
+    init_process_group()
+    import jax
+    if jax.process_count() <= 1:
+        return
+    client = None
+    try:
+        from jax._src import distributed as _jdist
+        client = getattr(_jdist.global_state, "client", None)
+    except Exception:            # internal layout moved
+        client = None
+    if client is not None:
+        client.wait_at_barrier(name, timeout_ms)
+        return
+    if threading.current_thread() is not threading.main_thread():
+        # falling back to sync_global_devices would launch a device
+        # collective from a side thread, interleaving with in-flight
+        # training collectives — the exact deadlock this function exists
+        # to avoid.  Fail loudly instead (a jax upgrade moved the
+        # coordination client; fix the lookup above).
+        raise MXNetError(
+            "coordination_barrier: jax's coordination-service client is "
+            "unavailable in this jax version and the device-collective "
+            "fallback is unsafe off the main thread")
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)
 
 
 # --------------------------------------------------------------------------

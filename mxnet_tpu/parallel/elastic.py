@@ -15,10 +15,21 @@ process holds a replica, so recovery is checkpoint-resume:
   the program it is a respawn, and ``latest_checkpoint``/``resume_or_start``
   pick up from the newest epoch checkpoint (the reference's
   ``fit(..., begin_epoch=k)`` + ``--load-epoch`` pattern, automated).
+
+Elastic v2 (docs/elastic.md): recovery cost is a checkpoint *interval*, not
+an epoch.  ``MXNET_CKPT_EVERY_N_STEPS`` makes :func:`fit_elastic` write
+sharded, asynchronous mid-epoch checkpoints (mxnet_tpu/checkpoint.py) every
+N optimizer updates; on respawn it resumes from the newest checkpoint of
+EITHER format — a sharded step checkpoint restores parameters, optimizer
+state, loss scale and the exact update count, skips the already-consumed
+batches of the interrupted epoch, and re-shards onto the CURRENT topology
+(a respawn at a smaller world size / different MXNET_PP rebuilds the mesh
+and restores instead of refusing).
 """
 from __future__ import annotations
 
 import glob
+import logging
 import os
 import re
 import threading
@@ -27,6 +38,8 @@ from ..base import get_env
 
 __all__ = ["health_check", "num_dead_node", "is_recovery",
            "latest_checkpoint", "resume_or_start", "fit_elastic"]
+
+_LOG = logging.getLogger(__name__)
 
 
 _health_lock = threading.Lock()
@@ -95,16 +108,34 @@ def is_recovery():
 
 _EPOCH_RE = re.compile(r"-(\d{4})\.params$")
 
+# per-process fit_elastic call counter: the epoch-end barrier ids must be
+# unique per use within one coordination-service lifetime (all ranks call
+# fit_elastic the same number of times, so the counter agrees world-wide)
+_barrier_seq_lock = threading.Lock()
+_barrier_seq = [0]
+
 
 def latest_checkpoint(prefix):
-    """Newest epoch for ``prefix-%04d.params`` checkpoints, or None."""
-    best = None
+    """Newest epoch for ``prefix-%04d.params`` checkpoints, or None.
+
+    Candidates are VALIDATED newest-first (``ndarray.validate_file``
+    walks the file framing with seeks — no tensor data is read): a
+    truncated or unreadable file — the footprint of a rank killed
+    mid-write before the atomic-rename era, or a torn copy — is skipped
+    with a warning instead of being returned as the newest, which would
+    crash (or worse, half-load) the resume."""
+    from .. import ndarray as nd
+    epochs = []
     for path in glob.glob("%s-*.params" % prefix):
         m = _EPOCH_RE.search(path)
         if m:
-            e = int(m.group(1))
-            best = e if best is None else max(best, e)
-    return best
+            epochs.append((int(m.group(1)), path))
+    for e, path in sorted(epochs, reverse=True):
+        if nd.validate_file(path):
+            return e
+        _LOG.warning("latest_checkpoint: skipping unreadable/truncated "
+                     "candidate %s", path)
+    return None
 
 
 def resume_or_start(module, prefix, load_optimizer_states=False):
@@ -126,22 +157,101 @@ def resume_or_start(module, prefix, load_optimizer_states=False):
     return epoch
 
 
+class _ResumeIter(object):
+    """DataIter wrapper for a mid-epoch resume: the FIRST epoch iterated
+    skips the ``skip`` batches the interrupted run already consumed (the
+    step-interval checkpoint records the in-epoch batch index), so the
+    resumed loss curve continues from the checkpoint instead of replaying
+    the epoch head.  Later epochs (after ``reset()``) pass through."""
+
+    def __init__(self, it, skip):
+        self._it = it
+        self._skip = int(skip)
+        self._first = True
+
+    def __iter__(self):
+        inner = iter(self._it)
+        if self._first:
+            self._first = False
+            for _ in range(self._skip):
+                try:
+                    next(inner)
+                except StopIteration:
+                    break
+        return inner
+
+    def reset(self):
+        self._first = False
+        self._it.reset()
+
+    def __getattr__(self, name):          # provide_data/label, batch_size…
+        return getattr(self._it, name)
+
+
+def _world_size():
+    # one owner for the jax-free MXTPU world/rank parsing: checkpoint.py
+    # (shard ownership and resume gating must never disagree on it)
+    from .. import checkpoint as _ckpt
+    return _ckpt._world()
+
+
+def _resume_point(prefix):
+    """Newest resume point across BOTH checkpoint formats, or None.
+
+    A monolithic ``prefix-NNNN.params`` means epoch NNNN completed —
+    position ``(NNNN, 0)``.  A sharded step checkpoint saved at
+    ``(epoch E, nbatch B)`` resumes at ``(E, B + 1)``.  The later
+    position wins, so per-epoch and per-interval checkpointing compose."""
+    from .. import checkpoint as _ckpt
+    epoch = latest_checkpoint(prefix)
+    mono = None if epoch is None else ("mono", (epoch, 0), epoch)
+    sharded_path = _ckpt.latest_sharded(prefix)
+    if sharded_path is not None:
+        man = _ckpt.load_manifest(sharded_path)
+        pos = (int(man["epoch"]), int(man["nbatch"]) + 1)
+        if mono is None or pos > mono[1]:
+            return ("sharded", pos, sharded_path, man)
+    return mono
+
+
 def fit_elastic(module, train_data, prefix, num_epoch, eval_data=None,
                 save_optimizer_states=True, **fit_kwargs):
-    """``Module.fit`` with per-epoch checkpointing and automatic resume.
+    """``Module.fit`` with checkpointing and automatic resume.
 
     On a fresh start trains epochs [0, num_epoch); after a crash + respawn
-    (or any rerun) it resumes from the newest ``prefix-NNNN.params``.  This
-    is the TPU-native replacement for the reference's PS hot-state recovery:
+    (or any rerun) it resumes from the newest checkpoint.  This is the
+    TPU-native replacement for the reference's PS hot-state recovery:
     state lives in checkpoints, the supervisor restarts the world, training
-    continues where the last completed epoch left off."""
+    continues where it left off.
+
+    Two checkpoint cadences compose:
+
+    - **per epoch** (always): ``prefix-NNNN.params`` (+ ``.states``) via the
+      classic ``do_checkpoint`` callback — rank 0 only under a multi-process
+      world (the other ranks meet it at a barrier), so concurrent writers
+      can never interleave one file;
+    - **per step interval** (``MXNET_CKPT_EVERY_N_STEPS=N``, read once at
+      dispatch): sharded async checkpoints (mxnet_tpu/checkpoint.py) of the
+      live fused training state every N optimizer updates — on a
+      preemptible fleet, recovery then costs an *interval*, not an epoch.
+
+    Resume picks whichever checkpoint is newest.  A sharded resume restores
+    parameters, optimizer state, loss-scale automaton and the exact update
+    count, skips the already-consumed batches of the interrupted epoch, and
+    re-shards onto the CURRENT topology — a respawn at a different world
+    size or stage count (``MXNET_PP``) rebuilds the mesh and restores
+    instead of refusing (docs/elastic.md has the matrix)."""
     from .. import callback as callback_mod
+    from .. import checkpoint as _ckpt
+    every = get_env("MXNET_CKPT_EVERY_N_STEPS", None, typ=int)
     begin = 0
-    if latest_checkpoint(prefix) is not None:
+    skip = 0
+    resume = _resume_point(prefix)
+    if resume is not None and resume[0] == "mono":
         # bind is needed before set_params; fit() would bind lazily, so
         # defer actual loading to arg_params via load_checkpoint
         from .. import model as model_mod
-        epoch = latest_checkpoint(prefix)
+        epoch = resume[2]
         _, arg_params, aux_params = model_mod.load_checkpoint(prefix, epoch)
         # the checkpoint MUST win over caller-supplied initial params: on a
         # crash-resume, keeping e.g. the original pretrained weights while
@@ -158,16 +268,56 @@ def fit_elastic(module, train_data, prefix, num_epoch, eval_data=None,
         if save_optimizer_states and os.path.exists(states):
             # Module loads this after init_optimizer inside fit()
             module._preload_opt_states = states
+    elif resume is not None:
+        _kind, (begin, skip), sharded_path, man = resume
+        man, params, opt_st, aux = _ckpt.load_sharded(sharded_path)
+        # logical host tensors reinitialise the module on ANY topology;
+        # the fused-fit hook (module._ckpt_resume) additionally restores
+        # optimizer state + update count + loss scale onto the step —
+        # from this SAME load (a multi-GB checkpoint must not be read
+        # and crc-verified twice on the recovery path)
+        fit_kwargs["arg_params"] = params
+        fit_kwargs["aux_params"] = aux
+        fit_kwargs["force_init"] = True
+        module._ckpt_resume = {"path": sharded_path, "man": man,
+                               "params": params, "opt_state": opt_st,
+                               "aux": aux}
+        _LOG.info("fit_elastic: resuming from sharded checkpoint %s "
+                  "(epoch %d, batch %d, step %d)", sharded_path, begin,
+                  skip, man["step"])
     if begin >= num_epoch:
+        # nothing to train: drop the resume hook or an UNRELATED later
+        # module.fit() would silently restore this checkpoint's state
+        module._ckpt_resume = None
         return module
     cb = fit_kwargs.pop("epoch_end_callback", None)
-    ckpt = callback_mod.do_checkpoint(prefix)
+    ckpt_cb = callback_mod.do_checkpoint(prefix)
+    world = _world_size()
+    with _barrier_seq_lock:
+        _barrier_seq[0] += 1
+        barrier_run = _barrier_seq[0]
 
     def _ckpt_with_states(iter_no, sym, arg, aux):
-        ckpt(iter_no, sym, arg, aux)
-        if save_optimizer_states:
-            module.save_optimizer_states("%s-%04d.states"
-                                         % (prefix, iter_no + 1))
+        # rank 0 is the single monolithic writer under a multi-process
+        # world (every process holds a full replica, so N ranks racing
+        # os.replace on one file is pure hazard); the others meet it at a
+        # barrier so no rank runs ahead into epoch E+1 while the
+        # checkpoint of E is still being written
+        if _world_size() == 1 or _rank_id() == 0:
+            ckpt_cb(iter_no, sym, arg, aux)
+            if save_optimizer_states:
+                module.save_optimizer_states("%s-%04d.states"
+                                             % (prefix, iter_no + 1))
+        if world > 1:
+            from . import dist
+            # coordination-service barrier: the async checkpoint writer
+            # may be mid-collective-free-barrier on its own thread, and a
+            # device-collective barrier here could interleave with it.
+            # The fit_elastic-call sequence number keeps the id unique
+            # when one process runs several elastic fits in a lifetime
+            # (coordination barrier ids are single-use).
+            dist.coordination_barrier("elastic-ckpt-%d-%d"
+                                      % (barrier_run, iter_no))
 
     if cb is None:
         extra = []
@@ -176,7 +326,37 @@ def fit_elastic(module, train_data, prefix, num_epoch, eval_data=None,
     else:
         extra = [cb]
     callbacks = [_ckpt_with_states] + extra
-    module.fit(train_data, eval_data=eval_data, num_epoch=num_epoch,
-               begin_epoch=begin, epoch_end_callback=callbacks,
-               **fit_kwargs)
+    batch_cbs = fit_kwargs.pop("batch_end_callback", None)
+    batch_cbs = [] if batch_cbs is None else (
+        list(batch_cbs) if isinstance(batch_cbs, (list, tuple))
+        else [batch_cbs])
+    ckptr = None
+    if every:
+        ckptr = _ckpt.Checkpointer(prefix)
+        batch_cbs = batch_cbs + [callback_mod.do_step_checkpoint(
+            module, ckptr, every, resume_epoch=begin, nbatch_offset=skip)]
+    data = _ResumeIter(train_data, skip) if skip else train_data
+    try:
+        module.fit(data, eval_data=eval_data, num_epoch=num_epoch,
+                   begin_epoch=begin, epoch_end_callback=callbacks,
+                   batch_end_callback=batch_cbs or None,
+                   **fit_kwargs)
+    finally:
+        if ckptr is not None:
+            # durability barrier: queued sharded saves land (or their
+            # failure surfaces) before fit_elastic returns
+            ckptr.close()
+    if getattr(module, "_ckpt_resume", None) is not None:
+        # the fused fit path never engaged, so only parameters were
+        # restored — momentum/Adam moments and the update count restarted
+        module._ckpt_resume = None
+        _LOG.warning(
+            "fit_elastic: sharded resume restored parameters only — the "
+            "fused fit path did not engage, so optimizer state and the "
+            "update count were re-initialised (general-path resume)")
     return module
+
+
+def _rank_id():
+    from .. import checkpoint as _ckpt
+    return _ckpt._rank()

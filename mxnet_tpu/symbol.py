@@ -282,7 +282,11 @@ class Symbol(object):
         }, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
+        # crash-consistent like every checkpoint artifact (temp + atomic
+        # rename — docs/elastic.md): save_checkpoint's symbol json must
+        # never be left truncated beside a valid .params file
+        from .base import atomic_write
+        with atomic_write(fname, mode="w") as f:
             f.write(self.tojson())
 
     def __reduce__(self):

@@ -106,17 +106,38 @@ def _host_init(symbol, low, param_names, aux_names, data_shapes,
     return params, aux
 
 
+def _flat_np(v, dp):
+    """Host-side ZeRO flat view: pad ``v`` and reshape to ``(dp, chunk)``
+    with ``chunk = ceil(size / dp)``.  THE save/restore wire contract for
+    ZeRO optimizer state — the checkpoint writer slices its rows and
+    ``load_sharded`` unpads by ``flat[:size]`` — so it exists exactly
+    once (state init and both ``place_checkpoint``s share it)."""
+    v = _np.asarray(v)
+    chunk = -(-v.size // dp)
+    out = _np.zeros((dp, chunk), v.dtype)
+    out.reshape(-1)[:v.size] = v.reshape(-1)
+    return out
+
+
 def _zero_state_host(fopt, params, dp):
     """ZeRO-1 optimizer state born as flat (dp, chunk) host templates —
     padded param values, so dcasgd's prev-weight state starts AT the
     weight exactly as in replicated mode."""
-    def flat_np(v):
-        v = _np.asarray(v)
-        chunk = -(-v.size // dp)
-        out = _np.zeros((dp, chunk), v.dtype)
-        out.reshape(-1)[:v.size] = v.reshape(-1)
-        return out
-    return fopt.init_state({n: flat_np(v) for n, v in params.items()})
+    return fopt.init_state({n: _flat_np(v, dp) for n, v in params.items()})
+
+
+def _scale_state_to_host(step):
+    """Loss-scale state as host scalars (checkpoint export), or None
+    without a policy — shared by TrainStep and PipelineTrainStep.
+    Syncs three scalars; checkpoint-time only."""
+    if not step._has_scale:
+        return None
+    import jax
+    state = step._scale_state_dev()
+    with _san.allow_sync("checkpoint loss-scale export"):
+        host = jax.device_get(state)
+    return {k: float(v) if k == "scale" else int(v)
+            for k, v in host.items()}
 
 
 def _xla_options():
@@ -591,6 +612,98 @@ class TrainStep(object):
 
     def _from_shards(self, xf, shape):
         return _from_flat_shards(xf, shape)
+
+    # ----------------------------------------------------------- checkpoint
+    def checkpoint_topology(self):
+        """Shard-ownership description for the sharded checkpoint writer
+        (mxnet_tpu/checkpoint.py): which stage owns each parameter/aux
+        tensor (all stage 0 here — one program), and how the optimizer
+        state is laid out (ZeRO-1 flat ``(dp, chunk)`` shards or
+        replicated).  The writer turns this into one shard file per
+        ownership group instead of N ranks racing to clobber one
+        monolithic ``.params``."""
+        return {"pp": 1,
+                "dp": self._dp,
+                "zero": self.zero,
+                "microbatches": None,
+                "stage_of": {n: 0 for n in self.param_names + self.aux_names}}
+
+    def place_checkpoint(self, host_params, host_state, host_aux,
+                         device=None):
+        """Place restored HOST pytrees onto this step's topology (the
+        restore half of any-topology resume: ``host_state`` leaves arrive
+        in the LOGICAL parameter shape and are re-sharded here —
+        ``zero=True`` re-chunks them to this mesh's ``(dp, chunk)`` flat
+        view, whatever topology saved them).  ``device`` pins the no-mesh
+        placement (the fused fit's module device); default is the ambient
+        context or the first LOCAL device — never a peer rank's."""
+        import jax
+        params = {n: _np.asarray(host_params[n]) for n in self.param_names}
+        aux = {n: _np.asarray(host_aux[n]) for n in self.aux_names}
+        if self.zero:
+            state = {n: tuple(_flat_np(s, self._dp)
+                              for s in host_state[n])
+                     for n in self.param_names}
+        else:
+            state = {n: tuple(_np.asarray(s) for s in host_state[n])
+                     for n in self.param_names}
+        if self.mesh is None:
+            rep = device if device is not None \
+                else _seq_replicated_sharding()
+            if rep is None:
+                from .context import Context
+                ambient = getattr(Context._default_ctx, "value", None)
+                # local_devices: under a multi-process world devices()[0]
+                # is rank 0's device — non-addressable from other ranks
+                rep = (ambient.jax_device() if ambient is not None
+                       else jax.local_devices()[0])
+            params = {n: jax.device_put(v, rep) for n, v in params.items()}
+            state = {n: tuple(jax.device_put(s, rep) for s in st)
+                     for n, st in state.items()}
+            aux = {n: jax.device_put(v, rep) for n, v in aux.items()}
+            return params, state, aux
+        from jax.sharding import NamedSharding
+        rep = NamedSharding(self.mesh, _pspec())
+
+        def shard_of(n):
+            if n in self.param_shardings:
+                return NamedSharding(self.mesh, self.param_shardings[n])
+            return rep
+        params = {n: jax.device_put(v, shard_of(n))
+                  for n, v in params.items()}
+        if self.zero:
+            sh_dp = NamedSharding(self.mesh, _pspec("dp"))
+            state = {n: tuple(jax.device_put(s, sh_dp) for s in st)
+                     for n, st in state.items()}
+        else:
+            state = {n: tuple(jax.device_put(s, shard_of(n)) for s in st)
+                     for n, st in state.items()}
+        aux = {n: jax.device_put(v, rep) for n, v in aux.items()}
+        return params, state, aux
+
+    def scale_state_host(self):
+        """Loss-scale state as host scalars (checkpoint export), or None
+        without a policy.  Syncs three scalars — checkpoint-time only."""
+        return _scale_state_to_host(self)
+
+    def load_scale_state(self, host):
+        """Restore the loss-scale automaton from checkpointed host scalars
+        (no-op without a policy: an f32 restore of an AMP checkpoint
+        simply drops the scale)."""
+        if not self._has_scale or host is None:
+            return
+        self._scale_state = None            # next _scale_state_dev places it
+        base = self.policy.init_state()
+        merged = {k: _np.asarray(host.get(k, base[k]), base[k].dtype)
+                  for k in base}
+        # place through the lazy path, then overwrite the values
+        dev = self._scale_state_dev()
+        import jax
+        self._scale_state = {k: jax.device_put(merged[k], v.sharding)
+                             if hasattr(v, "sharding")
+                             else jax.device_put(merged[k])
+                             for k, v in dev.items()}
+        self._overflow_seen = int(merged["overflow"])
 
     # ------------------------------------------------------------ loss scale
     def _scale_state_dev(self):
@@ -1194,6 +1307,69 @@ class PipelineTrainStep(object):
         same-device lazy reduction engages)."""
         from jax.sharding import NamedSharding
         return NamedSharding(self._subs[-1], _pspec())
+
+    # ----------------------------------------------------------- checkpoint
+    def checkpoint_topology(self):
+        """Shard ownership for the sharded checkpoint writer: each
+        parameter/aux tensor belongs to its pipeline stage (the stage
+        partition map rides in the manifest so restore can re-shard onto
+        a different stage count), optimizer state is per-stage —
+        dp-flat-sharded under ``zero=True``.  Requires the stage plan
+        (call init()/place_params() first)."""
+        if self._stages is None:
+            raise MXNetError(
+                "PipelineTrainStep.checkpoint_topology: call init() or "
+                "place_params() first — the stage plan is balanced from "
+                "parameter sizes")
+        return {"pp": self._pp,
+                "dp": self._dp,
+                "zero": self.zero,
+                "microbatches": self._micro,
+                "stage_of": dict(self._var_stage)}
+
+    def place_checkpoint(self, host_params, host_state, host_aux,
+                         device=None):
+        """Place restored HOST pytrees onto this pipeline's stages
+        (``host_state`` leaves arrive in the LOGICAL parameter shape;
+        ``zero=True`` re-chunks them over each stage sub-mesh's dp).
+        ``device`` is accepted for TrainStep API parity and ignored —
+        placement here is per stage sub-mesh."""
+        import jax
+        from jax.sharding import NamedSharding
+        self._ensure_plan({n: int(_np.asarray(v).size)
+                           for n, v in host_params.items()})
+        params = self.place_params(host_params)
+        aux = self.place_aux(host_aux)
+        if self.zero:
+            state = {}
+            for n, st in host_state.items():
+                sh = NamedSharding(self._subs[self._var_stage[n]],
+                                   _pspec("dp"))
+                state[n] = tuple(jax.device_put(_flat_np(s, self._dp), sh)
+                                 for s in st)
+        else:
+            state = self.place_state(host_state)
+        return params, state, aux
+
+    def scale_state_host(self):
+        """Loss-scale state as host scalars, or None without a policy
+        (mirrors TrainStep.scale_state_host)."""
+        return _scale_state_to_host(self)
+
+    def load_scale_state(self, host):
+        """Restore the loss-scale automaton onto the final stage's
+        sub-mesh (no-op without a policy)."""
+        if not self._has_scale or host is None:
+            return
+        import jax
+        from jax.sharding import NamedSharding
+        base = self.policy.init_state()
+        dst = NamedSharding(self._subs[-1], _pspec())
+        self._scale_state = {
+            k: jax.device_put(_np.asarray(host.get(k, base[k]),
+                                          base[k].dtype), dst)
+            for k in base}
+        self._overflow_seen = int(host.get("overflow", 0))
 
     # ------------------------------------------------------------ programs
     def _get_prog(self, kind, stage):

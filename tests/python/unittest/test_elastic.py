@@ -27,12 +27,35 @@ def _mlp(nc=4):
     return mx.sym.SoftmaxOutput(net, name="softmax")
 
 
+def _write_params(path):
+    mx.nd.save(path, {"arg:w": mx.nd.array(np.ones((2, 2), np.float32))})
+
+
 def test_latest_checkpoint(tmp_path):
     prefix = str(tmp_path / "model")
     assert elastic.latest_checkpoint(prefix) is None
     for e in (1, 3, 2):
-        open("%s-%04d.params" % (prefix, e), "wb").close()
+        _write_params("%s-%04d.params" % (prefix, e))
     assert elastic.latest_checkpoint(prefix) == 3
+
+
+def test_latest_checkpoint_skips_truncated(tmp_path):
+    """A candidate killed mid-write (truncated / empty / garbage) must
+    never be returned as newest — resume falls back to the previous
+    complete checkpoint instead of crashing on it."""
+    prefix = str(tmp_path / "model")
+    for e in (1, 2):
+        _write_params("%s-%04d.params" % (prefix, e))
+    # epoch 3: a torn copy — valid header, payload cut short
+    good = open("%s-%04d.params" % (prefix, 2), "rb").read()
+    with open("%s-%04d.params" % (prefix, 3), "wb") as f:
+        f.write(good[:len(good) - 7])
+    # epoch 4: zero bytes (crash before any write)
+    open("%s-%04d.params" % (prefix, 4), "wb").close()
+    # epoch 5: not a params file at all
+    with open("%s-%04d.params" % (prefix, 5), "wb") as f:
+        f.write(b"definitely not a checkpoint")
+    assert elastic.latest_checkpoint(prefix) == 2
 
 
 def test_is_recovery(monkeypatch):

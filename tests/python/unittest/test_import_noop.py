@@ -48,6 +48,13 @@ import mxnet_tpu.io
 import mxnet_tpu.image
 import mxnet_tpu.engine
 import mxnet_tpu.serving
+import mxnet_tpu.checkpoint
+
+# the checkpoint writer thread exists only after an ASYNC save: importing
+# the module (and even constructing a Checkpointer) starts nothing with
+# the checkpoint env unset — the elastic-v2 no-op clause
+_ckptr = mxnet_tpu.checkpoint.Checkpointer("probe-ckpt")
+assert _ckptr._thread is None, "checkpoint writer thread pre-created"
 
 # mxsan's no-op contract is wider than threads/files: no patched jax
 # function and no logging handler either (sanitize's "no hook" clause)

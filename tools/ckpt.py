@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Inspect an mxnet_tpu sharded checkpoint (mxnet_tpu/checkpoint.py).
+
+Usage:
+    python tools/ckpt.py <ckpt-dir-or-prefix> [--verify] [--manifest] [--json]
+
+Given a checkpoint DIRECTORY (``<prefix>-stepNNNNNNNN.ckpt``) renders its
+topology (pp/dp/ZeRO/world), the stage partition, and the shard table; given
+a PREFIX, resolves the newest complete checkpoint first (the same rule the
+elastic resume uses: manifest present = complete).
+
+* ``--verify``    re-read every shard and check size + crc32 against the
+                  manifest (exit 2 on any mismatch or missing shard);
+* ``--manifest``  dump the raw manifest JSON;
+* ``--json``      machine-readable summary instead of the rendered view.
+
+Pure stdlib — the shard payloads are never deserialised (verification
+hashes raw bytes), so this runs anywhere the files do.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import zlib
+
+SUFFIX = ".ckpt"
+MANIFEST = "manifest.json"
+FORMAT = "mxtpu-sharded-checkpoint"
+_STEP_RE = re.compile(r"-step(\d{8,})" + re.escape(SUFFIX) + r"$")
+
+
+def _complete(d):
+    """Same completeness rule as the elastic resume (checkpoint.
+    latest_sharded): manifest present + every listed shard at its
+    recorded size — so the tool resolves the SAME 'newest' checkpoint
+    the runtime would resume from."""
+    mpath = os.path.join(d, MANIFEST)
+    if not os.path.isfile(mpath):
+        return False
+    try:
+        with open(mpath) as f:
+            man = json.load(f)
+    except (ValueError, OSError):
+        return False
+    for fname, meta in man.get("shards", {}).items():
+        full = os.path.join(d, fname)
+        if not os.path.isfile(full) \
+                or os.path.getsize(full) != meta.get("bytes"):
+            return False
+    return True
+
+
+def resolve(path_or_prefix):
+    """A checkpoint dir as given (even incomplete — for debugging), or
+    the newest COMPLETE one for a prefix."""
+    if os.path.isdir(path_or_prefix):
+        if os.path.isfile(os.path.join(path_or_prefix, MANIFEST)):
+            return path_or_prefix
+        if _STEP_RE.search(path_or_prefix.rstrip("/")):
+            # an explicitly-named checkpoint dir without a manifest: the
+            # operator is inspecting an interrupted save — say exactly
+            # that instead of pretending the prefix has no checkpoints
+            raise SystemExit(
+                "ckpt.py: %s has no %s — an interrupted save (shards "
+                "without a manifest are invisible to the elastic resume)"
+                % (path_or_prefix, MANIFEST))
+    best = None
+    for d in glob.glob("%s-step*%s" % (path_or_prefix, SUFFIX)):
+        m = _STEP_RE.search(d)
+        if m and _complete(d):
+            # order by the manifest's DATA POSITION like the runtime's
+            # latest_sharded — after a counter-restarting resume, stale
+            # pre-crash dirs carry higher filename steps than the
+            # checkpoint the run actually resumes from
+            with open(os.path.join(d, MANIFEST)) as f:
+                man = json.load(f)
+            pos = (int(man.get("epoch", 0)), int(man.get("nbatch", 0)),
+                   int(man.get("step", m.group(1))))
+            if best is None or pos > best[0]:
+                best = (pos, d)
+    if best is None:
+        raise SystemExit("ckpt.py: no complete sharded checkpoint at %r "
+                         "(a dir without %s is an interrupted save)"
+                         % (path_or_prefix, MANIFEST))
+    return best[1]
+
+
+def load_manifest(path):
+    with open(os.path.join(path, MANIFEST)) as f:
+        man = json.load(f)
+    if man.get("format") != FORMAT:
+        raise SystemExit("ckpt.py: %s is not an mxtpu sharded checkpoint "
+                         "(format=%r)" % (path, man.get("format")))
+    return man
+
+
+def verify(path, man):
+    """[(fname, problem)] — empty when every shard checks out."""
+    problems = []
+    for fname in sorted(man.get("shards", {})):
+        meta = man["shards"][fname]
+        full = os.path.join(path, fname)
+        if not os.path.isfile(full):
+            problems.append((fname, "MISSING (group %s, rank %d)"
+                             % (meta["group"], meta["rank"])))
+            continue
+        with open(full, "rb") as f:
+            blob = f.read()
+        crc = zlib.crc32(blob) & 0xFFFFFFFF
+        if len(blob) != meta["bytes"]:
+            problems.append((fname, "size %d != manifest %d"
+                             % (len(blob), meta["bytes"])))
+        elif crc != meta["crc32"]:
+            problems.append((fname, "crc32 %08x != manifest %08x"
+                             % (crc, meta["crc32"])))
+    return problems
+
+
+def summarize(path, man):
+    topo = man.get("topology", {})
+    stages = {}
+    for name, s in sorted(man.get("stage_of", {}).items()):
+        stages.setdefault(s, []).append(name)
+    shards = man.get("shards", {})
+    return {
+        "path": path,
+        "version": man.get("version"),
+        "step": man.get("step"),
+        "epoch": man.get("epoch"),
+        "nbatch": man.get("nbatch"),
+        "topology": topo,
+        "stages": {str(s): names for s, names in sorted(stages.items())},
+        "shards": {f: shards[f] for f in sorted(shards)},
+        "total_bytes": sum(m["bytes"] for m in shards.values()),
+        "has_opt_state": man.get("opt_state") is not None,
+        "extra": sorted((man.get("extra") or {}).keys()),
+    }
+
+
+def render(summary, out=sys.stdout):
+    t = summary["topology"]
+    out.write("== sharded checkpoint: %s ==\n" % summary["path"])
+    out.write("step   %s  (epoch %s, batch %s)  format v%s\n"
+              % (summary["step"], summary["epoch"], summary["nbatch"],
+                 summary["version"]))
+    out.write("saved under  pp=%s dp=%s zero=%s world=%s%s\n"
+              % (t.get("pp"), t.get("dp"), t.get("zero"), t.get("world"),
+                 "  M=%s" % t["microbatches"]
+                 if t.get("microbatches") else ""))
+    out.write("opt state    %s    extra: %s\n"
+              % ("yes" if summary["has_opt_state"] else "no",
+                 ", ".join(summary["extra"]) or "-"))
+    out.write("\nStage partition\n")
+    for s, names in summary["stages"].items():
+        out.write("  stage %-3s %d tensor(s): %s\n"
+                  % (s, len(names), ", ".join(names[:6])
+                     + (" …" if len(names) > 6 else "")))
+    out.write("\nShards (%d, %.1f KiB total)\n"
+              % (len(summary["shards"]), summary["total_bytes"] / 1024.0))
+    for fname, meta in summary["shards"].items():
+        out.write("  %-28s group %-14s rank %-3d %8d B  crc32 %08x\n"
+                  % (fname, meta["group"], meta["rank"], meta["bytes"],
+                     meta["crc32"]))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="checkpoint directory or prefix")
+    ap.add_argument("--verify", action="store_true",
+                    help="re-read every shard, check size + crc32")
+    ap.add_argument("--manifest", action="store_true",
+                    help="dump the raw manifest JSON")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable summary")
+    args = ap.parse_args(argv)
+    path = resolve(args.path)
+    man = load_manifest(path)
+    if args.manifest:
+        json.dump(man, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0
+    summary = summarize(path, man)
+    problems = verify(path, man) if args.verify else None
+    if args.json:
+        if problems is not None:
+            summary["verify"] = {"ok": not problems,
+                                 "problems": ["%s: %s" % p
+                                              for p in problems]}
+        json.dump(summary, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        render(summary)
+        if problems is not None:
+            if problems:
+                sys.stdout.write("\nVERIFY: %d problem(s)\n"
+                                 % len(problems))
+                for fname, why in problems:
+                    sys.stdout.write("  %s: %s\n" % (fname, why))
+            else:
+                sys.stdout.write("\nVERIFY: all shards ok\n")
+    return 2 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,7 +46,10 @@ def _free_port():
 # logic lives in exactly one place.
 OBSERVABILITY_ENV = ("MXNET_TELEMETRY", "MXNET_TELEMETRY_FUSED",
                      "MXNET_METRICS_PORT", "MXNET_DIAG_DIR",
-                     "MXNET_WATCHDOG_SEC", "MXNET_CHECK_NUMERICS")
+                     "MXNET_WATCHDOG_SEC", "MXNET_CHECK_NUMERICS",
+                     # elastic-v2 checkpoint cadence: every worker must
+                     # agree on the interval or resume points desync
+                     "MXNET_CKPT_EVERY_N_STEPS", "MXNET_CKPT_ASYNC")
 
 
 def observability_env():
