@@ -301,7 +301,10 @@ class _Lowered(object):
     def stage_partition(self, num_stages, input_names=(), param_sizes=None):
         """Partition the op sequence into ``num_stages`` contiguous stages
         (the GPipe layer split, rebuilt on the nnvm-style graph: PAPER.md
-        §4a partitions the executor graph the same way).
+        §4a partitions the executor graph the same way).  The interleaved
+        pipeline schedule passes ``num_stages = pp * v`` and assigns chunk
+        ``k`` to device slice ``k % pp`` — the cut machinery is identical;
+        only the placement convention differs (train.PipelineTrainStep).
 
         Cuts land only on glue-legal boundaries (no fusion pair straddles a
         stage edge) and balance the per-stage parameter footprint when

@@ -492,10 +492,11 @@ def _fused_fit_key_fields(opt, policy):
     ARE part of the key (CKEY001): the step traces executor._Lowered.run,
     so toggling e.g. MXNET_STEM_FUSE between fit() calls must land on a
     fresh compile, exactly like toggling MXNET_AMP.  The pipeline levers
-    (MXNET_PP / MXNET_PP_MICROBATCH, dispatch-time reads — docs/env_var.md
-    "Pipeline parallelism") key the cache the same way: toggling them
-    between fits swaps the TrainStep for a PipelineTrainStep (or back)
-    instead of reusing the stale step.  mxsan's RECOMPILE checker watches
+    (MXNET_PP / MXNET_PP_MICROBATCH / MXNET_PP_SCHEDULE /
+    MXNET_PP_INTERLEAVE, dispatch-time reads — docs/env_var.md "Pipeline
+    parallelism") key the cache the same way: toggling them between fits
+    swaps the TrainStep for a PipelineTrainStep (or back, or rebuilds it
+    under the newly-selected schedule) instead of reusing the stale step.  mxsan's RECOMPILE checker watches
     this cache through these named fields — a seeded regression (step
     state re-entering the key) is named field-by-field."""
     from ..base import get_env, trace_env_key
@@ -511,6 +512,8 @@ def _fused_fit_key_fields(opt, policy):
         "trace_env": trace_env_key(),
         "pp": get_env("MXNET_PP", None, typ=int),
         "pp_microbatch": get_env("MXNET_PP_MICROBATCH", None, typ=int),
+        "pp_schedule": get_env("MXNET_PP_SCHEDULE", None),
+        "pp_interleave": get_env("MXNET_PP_INTERLEAVE", None, typ=int),
     }
 
 
@@ -562,6 +565,8 @@ class _FusedFit(object):
                 label_names=tuple(module._label_names),
                 mesh=make_pp_mesh(pp),
                 num_microbatches=fields["pp_microbatch"],
+                schedule=fields["pp_schedule"],
+                interleave=fields["pp_interleave"],
                 policy=policy)
             module._fused_ts_cache = (key, self._ts)
             san.miss(fields)

@@ -1,6 +1,8 @@
 """Parallelism substrate (TPU-native; SURVEY.md §2.6/§5.7/§5.8).
 
 - ``mesh``: device-mesh helpers (dp/tp/pp/sp axes) over jax.sharding.Mesh
+- ``schedule``: pipeline dispatch schedules (gpipe / 1f1b / interleaved
+  virtual stages) — pure work-item order generation + slot-model scoring
 - ``dist``: multi-host runtime (rank/size/allreduce/barrier) — the ps-lite/
   tracker replacement built on jax.distributed + XLA collectives over ICI/DCN
 - ``elastic``: failure detection + checkpoint-resume recovery (the ps-lite
@@ -10,4 +12,5 @@
 """
 from . import dist
 from . import mesh
+from . import schedule
 from . import elastic

@@ -35,17 +35,30 @@ __all__ = ["TrainStep", "EvalStep", "PipelineTrainStep",
            "pipeline_bubble_fraction"]
 
 
-def pipeline_bubble_fraction(pp, microbatches):
-    """Idle-slot share of the executed GPipe schedule: each of the ``pp``
-    stages is busy for ``M`` of the ``M + pp - 1`` slot-times of both the
-    forward and backward waves, so the fill/drain bubble is
-    ``(pp - 1) / (pp - 1 + M)`` — shrinking as the microbatch count grows."""
-    return float(pp - 1) / float(pp - 1 + microbatches)
+def pipeline_bubble_fraction(pp, microbatches, interleave=1):
+    """Idle-slot share of the executed pipeline schedule under the
+    equal-cost slot model.  GPipe and 1F1B both pay the fill/drain ramp
+    once per wave — ``(pp - 1) / (pp - 1 + M)``, shrinking as the
+    microbatch count grows (1F1B's win is activation memory, not the
+    bubble).  The interleaved schedule cuts ``v = interleave`` virtual
+    chunks per device slice, so each ramp costs one chunk (1/v of a
+    stage) and the bubble drops to ``(pp - 1) / ((pp - 1) + v * M)``.
+    The executed dispatch schedule is asserted against this closed form
+    at plan-build time (parallel/schedule.py simulate)."""
+    return float(pp - 1) / float(pp - 1 + interleave * microbatches)
 
 
 def _pspec(*names):
     from jax.sharding import PartitionSpec
     return PartitionSpec(*names)
+
+
+def _chunk_rows(size, dp):
+    """Row width of the flat (dp, chunk) shard view for a tensor of
+    ``size`` elements — THE layout contract between ``_flat_shards`` and
+    everything that slices its output (the pipeline gradient bucket's
+    offsets, the ZeRO update's per-param views): exactly one place."""
+    return -(-size // dp)
 
 
 def _flat_shards(x, dp):
@@ -56,7 +69,7 @@ def _flat_shards(x, dp):
     size = 1
     for d in x.shape:
         size *= d
-    chunk = -(-size // dp)
+    chunk = _chunk_rows(size, dp)
     flat = jnp.reshape(x, (-1,))
     pad = dp * chunk - size
     if pad:
@@ -113,7 +126,7 @@ def _flat_np(v, dp):
     ``load_sharded`` unpads by ``flat[:size]`` — so it exists exactly
     once (state init and both ``place_checkpoint``s share it)."""
     v = _np.asarray(v)
-    chunk = -(-v.size // dp)
+    chunk = _chunk_rows(v.size, dp)
     out = _np.zeros((dp, chunk), v.dtype)
     out.reshape(-1)[:v.size] = v.reshape(-1)
     return out
@@ -605,7 +618,7 @@ class TrainStep(object):
 
     # ---------------------------------------------------------- ZeRO-1 views
     def _chunk(self, size):
-        return -(-size // self._dp)
+        return _chunk_rows(size, self._dp)
 
     def _to_shards(self, x):
         return _flat_shards(x, self._dp)
@@ -1069,16 +1082,40 @@ class PipelineTrainStep(object):
     (``executor._Lowered.stage_partition`` — fusion-glue-legal cuts,
     parameter-footprint balanced), stage ``s`` living on slice ``s`` of the
     mesh's ``pp`` axis (``parallel.mesh.pp_submeshes``); each global batch
-    splits into ``M`` microbatches and runs the GPipe fill/steady/drain
-    schedule: a forward wave (per-stage jitted programs dispatched in
-    dependency order — stages on disjoint device slices overlap through
-    XLA's async dispatch), then a backward wave with per-stage gradient
-    accumulation, then one optimizer update per stage.  Activations cross
+    splits into ``M`` microbatches and runs the configured dispatch
+    schedule (per-stage jitted programs dispatched in dependency order —
+    stages on disjoint device slices overlap through XLA's async
+    dispatch), then one optimizer update per stage.  Activations cross
     stage boundaries as explicit resharding transfers
     (``jax.device_put`` onto the next stage's sub-mesh, dp-sharded), so the
-    runtime inserts the device-to-device copies.  The idle-slot share of
-    the executed schedule is ``(pp-1)/(pp-1+M)``
-    (:func:`pipeline_bubble_fraction`), shrinking as M grows.
+    runtime inserts the device-to-device copies.
+
+    Schedules (``schedule=`` / ``MXNET_PP_SCHEDULE``; parallel/schedule.py
+    generates and scores the dispatch orders, and the executed order is
+    asserted against :func:`pipeline_bubble_fraction` at plan build):
+
+    - ``'gpipe'`` (default): forward wave then backward wave.  Idle share
+      ``(pp-1)/(pp-1+M)``; every in-flight microbatch's boundary
+      activations stay stashed through the forward wave (memory grows
+      with M).
+    - ``'1f1b'``: per-stage warm-up forwards, then the steady state
+      interleaves one forward with one backward — same bubble, but at
+      most ``min(M, pp)`` microbatches' boundary activations are ever
+      live per slice (bounded by pp, not M).
+    - ``'interleaved'``: the symbol is cut into ``pp x v`` *virtual*
+      stages (``interleave=`` / ``MXNET_PP_INTERLEAVE``, default v=2) and
+      slice ``d`` owns chunks ``{d, d+pp, ...}``; each fill/drain ramp
+      costs one chunk, so the bubble drops to ``(pp-1)/((pp-1)+v*M)``.
+      Needs ``M % pp == 0``.
+
+    On a ``dp x pp`` mesh the v2 schedules (1f1b/interleaved) also overlap
+    the dp gradient communication: per-stage gradients accumulate as flat
+    ``(dp, chunk)`` bucket shards (each microbatch backward pays a
+    reduce-scatter instead of a full all-reduce) and the stage's one
+    bucketed all-gather is issued the moment its backward wave completes,
+    hiding under the other slices' compute; ZeRO updates consume the
+    shards directly and skip the gather entirely.  GPipe keeps PR 10's
+    byte-identical in-program reduction.
 
     Composition:
     - **dp**: a ``dp x pp`` mesh shards every microbatch over the stage
@@ -1115,8 +1152,11 @@ class PipelineTrainStep(object):
 
     def __init__(self, symbol, optimizer, data_names=("data",),
                  label_names=("softmax_label",), mesh=None,
-                 num_microbatches=None, zero=False, policy=None, dtype=None):
+                 num_microbatches=None, zero=False, policy=None, dtype=None,
+                 schedule=None, interleave=None):
+        from .base import get_env
         from .executor import _Lowered
+        from .parallel import schedule as _sched
         if mesh is None or "pp" not in mesh.axis_names:
             raise MXNetError(
                 "PipelineTrainStep needs a mesh with a 'pp' axis "
@@ -1151,6 +1191,33 @@ class PipelineTrainStep(object):
         if self._micro < 1:
             raise MXNetError("PipelineTrainStep: num_microbatches must be "
                              ">= 1, got %d" % self._micro)
+        # schedule layer (docs/distributed.md "Pipeline schedules"):
+        # gpipe (fill/drain), 1f1b (steady-state one-forward-one-backward;
+        # boundary-activation stash bounded by pp, not M), interleaved
+        # (pp x v virtual stages per 1F1B slot; bubble / v).  Arguments
+        # default to the MXNET_PP_SCHEDULE / MXNET_PP_INTERLEAVE levers —
+        # dispatch-time reads (the fused-fit cache keys on them).
+        if schedule is None:
+            schedule = get_env("MXNET_PP_SCHEDULE", "gpipe")
+        if interleave is None:
+            interleave = get_env("MXNET_PP_INTERLEAVE", None, typ=int)
+            if interleave is None:
+                interleave = 2 if str(schedule).lower() == "interleaved" \
+                    else 1
+        self._schedule, self._v = _sched.validate_schedule(
+            schedule, self._pp, self._micro, interleave)
+        # virtual stage count: device slice d owns the v non-contiguous
+        # chunks {d, d+pp, ...}; v == 1 keeps physical stages
+        self._V = self._pp * self._v
+        # overlapped dp gradient communication (v2 schedules on a dp x pp
+        # mesh): gradients accumulate as flat (dp, chunk) bucket shards —
+        # each microbatch backward pays a reduce-scatter instead of a full
+        # all-reduce — and the one bucketed all-gather per stage is issued
+        # as soon as that stage's backward wave completes, hiding under
+        # the other slices' compute (ZeRO updates consume the shards
+        # directly; no gather at all).  GPipe keeps PR 10's byte-identical
+        # in-program reduction.
+        self._overlap = self._dp > 1 and self._schedule != "gpipe"
         self.zero = bool(zero)
         if self.zero and "dp" not in mesh.axis_names:
             raise MXNetError(
@@ -1177,27 +1244,42 @@ class PipelineTrainStep(object):
         self._stage_has_loss = None
         self._micro_comp = False
         self._progs = {}
+        # per-step live-byte accounting (params/state/aux plus the PEAK
+        # boundary-activation stash per device slice, tracked at dispatch
+        # time from shape metadata — no syncs); mirrors the
+        # pp_stage<N>_live_bytes gauges, readable with telemetry off
+        self.last_live_bytes = None
         # mxsan RECOMPILE: the per-(kind, stage, trace-env) program cache
         # (CKEY001 CACHES entry: tools/mxlint/rule_ckey.py).  One env
-        # snapshot costs at most fwd/bwd/upd/zeros per stage plus the AMP
-        # fin/auxsel/scale programs.
+        # snapshot costs at most fwd/bwd/upd/zeros per virtual stage plus
+        # the AMP fin/auxsel/scale and overlap gather programs.
         self._san_cache = _san.register_cache(
             "pipeline.stages", kind="pipeline", owner=self,
-            sizer=lambda ps: len(ps._progs), warmup=7 * self._pp + 2,
+            sizer=lambda ps: len(ps._progs), warmup=8 * self._V + 2,
             jit_names=("mxtpu_pp_fwd", "mxtpu_pp_bwd", "mxtpu_pp_upd",
                        "mxtpu_pp_zeros", "mxtpu_pp_fin", "mxtpu_pp_scale",
-                       "mxtpu_pp_auxsel"))
+                       "mxtpu_pp_auxsel", "mxtpu_pp_gather"))
+        # the dispatch-plan cache: per-(schedule, interleave, M, trace-env)
+        # merged work-item order + its simulated bubble (CKEY001 CACHES
+        # entry; pure host-side python — the plan's stage programs land in
+        # the pipeline.stages cache above, keyed by the same trace env)
+        self._plans = {}
+        self._san_plan_cache = _san.register_cache(
+            "pipeline.schedule", kind="pipeline_plan", owner=self,
+            sizer=lambda ps: len(ps._plans), warmup=2)
 
     # ------------------------------------------------------------- planning
     def _ensure_plan(self, param_sizes=None):
         if self._stages is not None:
             return
+        # pp x v chunks: the interleaved schedule's virtual stages are
+        # plain stage_partition cuts; chunk k runs on device slice k % pp
         self._stages = self._low.stage_partition(
-            self._pp, input_names=self._inputs_all, param_sizes=param_sizes)
+            self._V, input_names=self._inputs_all, param_sizes=param_sizes)
         for st in self._stages:
             for n in list(st.params) + list(st.aux):
                 self._var_stage[n] = st.index
-        has_loss = [False] * self._pp
+        has_loss = [False] * self._V
         norm_modes = set()
         for st in self._stages:
             for n in st.nodes:
@@ -1222,8 +1304,65 @@ class PipelineTrainStep(object):
         self._micro_comp = (self._micro > 1 and norm_modes == {"batch"})
 
     def stages(self):
-        """The stage plan (list of executor._Stage; finalised lazily)."""
+        """The stage plan (list of executor._Stage; finalised lazily).
+        ``pp * interleave`` virtual stages; stage ``k`` lives on device
+        slice ``k % pp``."""
         return self._stages
+
+    def _sub(self, k):
+        """Device-slice sub-mesh of virtual stage ``k`` (round-robin:
+        slice ``k % pp`` owns chunks {d, d+pp, ...})."""
+        return self._subs[k % self._pp]
+
+    def schedule(self):
+        """(schedule_name, interleave) of this step's dispatch plan."""
+        return self._schedule, self._v
+
+    def _get_plan(self):
+        """The merged dispatch plan for this step's (schedule, interleave,
+        M): work items in simulated-slot order plus the executed bubble
+        fraction, asserted against the closed form.  Keyed on
+        ``trace_env_key()`` for contract uniformity with the stage-program
+        cache it drives (CKEY001) — a rebuild is pure host-side python."""
+        from .parallel import schedule as _sched
+        key = (self._schedule, self._v, self._micro, trace_env_key())
+        plan = self._plans.get(key)
+        if plan is not None:
+            return plan
+        orders = _sched.stage_orders(self._pp, self._micro, self._schedule,
+                                     self._v)
+        if self._schedule == "gpipe":
+            # PR 10's literal dispatch order (m-major waves) — the
+            # MXNET_PP_SCHEDULE-unset path stays byte-identical; the
+            # simulation still scores the per-slice order
+            sim = _sched.simulate(orders, self._pp, self._v)
+            items = [("fwd", m, k) for m in range(self._micro)
+                     for k in range(self._V)]
+            items += [("bwd", m, k) for m in reversed(range(self._micro))
+                      for k in reversed(range(self._V))]
+        else:
+            items, sim = _sched.dispatch_order(orders, self._pp, self._v)
+        want = pipeline_bubble_fraction(self._pp, self._micro, self._v)
+        if abs(sim["bubble"] - want) > 1e-9:
+            raise MXNetError(
+                "pipeline schedule %s: executed idle share %.6f does not "
+                "match pipeline_bubble_fraction(pp=%d, M=%d, v=%d)=%.6f"
+                % (self._schedule, sim["bubble"], self._pp, self._micro,
+                   self._v, want))
+        # last backward per virtual stage: where the overlap path issues
+        # the stage's bucketed gradient gather
+        last_bwd = {}
+        for i, (kind, m, k) in enumerate(items):
+            if kind == "bwd":
+                last_bwd[k] = i
+        plan = {"items": items, "bubble": sim["bubble"],
+                "last_bwd": last_bwd}
+        self._plans[key] = plan
+        self._san_plan_cache.miss({"schedule": self._schedule,
+                                   "interleave": self._v,
+                                   "microbatches": self._micro,
+                                   "trace_env": key[3]})
+        return plan
 
     # ----------------------------------------------------------- placement
     def _stage_of_var(self, name):
@@ -1237,7 +1376,7 @@ class PipelineTrainStep(object):
     def param_sharding(self, name):
         """Replicated NamedSharding on ``name``'s stage sub-mesh."""
         from jax.sharding import NamedSharding
-        return NamedSharding(self._subs[self._stage_of_var(name)], _pspec())
+        return NamedSharding(self._sub(self._stage_of_var(name)), _pspec())
 
     def place_params(self, host_params):
         """Host {name: array} -> per-stage device placement (finalising
@@ -1287,7 +1426,7 @@ class PipelineTrainStep(object):
             host_state = _zero_state_host(self.fopt, params, self._dp)
             dev_state = {}
             for n, st in host_state.items():
-                sh = NamedSharding(self._subs[self._var_stage[n]],
+                sh = NamedSharding(self._sub(self._var_stage[n]),
                                    _pspec("dp"))
                 dev_state[n] = tuple(jax.device_put(s, sh) for s in st)
         else:
@@ -1325,6 +1464,8 @@ class PipelineTrainStep(object):
                 "dp": self._dp,
                 "zero": self.zero,
                 "microbatches": self._micro,
+                "schedule": self._schedule,
+                "interleave": self._v,
                 "stage_of": dict(self._var_stage)}
 
     def place_checkpoint(self, host_params, host_state, host_aux,
@@ -1343,7 +1484,7 @@ class PipelineTrainStep(object):
         if self.zero:
             state = {}
             for n, st in host_state.items():
-                sh = NamedSharding(self._subs[self._var_stage[n]],
+                sh = NamedSharding(self._sub(self._var_stage[n]),
                                    _pspec("dp"))
                 state[n] = tuple(jax.device_put(_flat_np(s, self._dp), sh)
                                  for s in st)
@@ -1403,7 +1544,7 @@ class PipelineTrainStep(object):
         import jax.numpy as jnp
         from jax.sharding import NamedSharding
         stage = self._stages[s]
-        sub = self._subs[s]
+        sub = self._sub(s)
         low = self._low
         dtype = self._dtype
         label_names = set(self.label_names)
@@ -1436,6 +1577,27 @@ class PipelineTrainStep(object):
             return jax.lax.with_sharding_constraint(
                 x, NamedSharding(sub, self._carry_spec(x, sub)))
 
+        names = list(stage.params)
+        dp = self._dp
+        sh_dp = NamedSharding(sub, _pspec("dp"))
+        overlap = self._overlap
+
+        def bucket_chunks(params):
+            """Static (name, chunk_rows) layout of this stage's flat
+            gradient bucket: per-param ZeRO-flat ``(dp, chunk)`` views
+            concatenated along the chunk axis, so row ``d`` holds device
+            ``d``'s shard of every parameter contiguously.  Widths come
+            from ``_chunk_rows`` — the same helper ``_flat_shards`` uses
+            to BUILD the views ``accumulate`` concatenates, so the
+            gather/update offsets can never drift from the layout."""
+            out = []
+            for n in names:
+                size = 1
+                for dim in params[n].shape:
+                    size *= dim
+                out.append((n, _chunk_rows(size, dp)))
+            return out
+
         if kind == "fwd":
             def fwd(params, aux, carry, extra, rng, m):
                 outs, aux_upd, carry_out = run_fwd(params, aux, carry,
@@ -1461,6 +1623,26 @@ class PipelineTrainStep(object):
                 (self._has_scale or self._micro_comp)
             comp = jnp.float32(1.0 / micro) if self._micro_comp else None
 
+            if overlap:
+                def accumulate(params, gp, acc):
+                    # overlapped dp comm: fold this microbatch's gradients
+                    # into the flat (dp, chunk) bucket — the dp-sharded
+                    # constraint lowers the reduction as a reduce-scatter
+                    # (half an all-reduce per microbatch); the gather half
+                    # is issued once, when the stage's backward wave
+                    # completes
+                    if not names:
+                        return acc
+                    flat = jnp.concatenate(
+                        [_flat_shards(gp[n].astype(acc.dtype), dp)
+                         for n in names], axis=1)
+                    return acc + jax.lax.with_sharding_constraint(flat,
+                                                                  sh_dp)
+            else:
+                def accumulate(params, gp, acc):
+                    return {n: acc[n] + gp[n].astype(acc[n].dtype)
+                            for n in acc}
+
             def bwd_core(params, carry, aux, extra, gout, acc, rng, m,
                          scale):
                 def f(p, c):
@@ -1471,9 +1653,7 @@ class PipelineTrainStep(object):
                 cot = (tuple(gout),
                        tuple(jnp.ones(o.shape, o.dtype) for o in outs))
                 gp, gc = vjp_fn(cot)
-                new_acc = {n: acc[n] + gp[n].astype(acc[n].dtype)
-                           for n in acc}
-                return gc, new_acc
+                return gc, accumulate(params, gp, acc)
 
             if scaled and self._has_scale:
                 def bwd(params, carry, aux, extra, gout, acc, rng, m,
@@ -1493,25 +1673,68 @@ class PipelineTrainStep(object):
             return jax.jit(bwd, donate_argnums=(5,))
 
         if kind == "zeros":
+            if overlap:
+                def zeros(params):
+                    chunks = bucket_chunks(params)
+                    width = sum(c for _, c in chunks)
+                    dt = jnp.result_type(*[params[n].dtype
+                                           for n in names]) \
+                        if names else jnp.float32
+                    return jnp.zeros((dp, width), dt)
+                zeros.__name__ = "mxtpu_pp_zeros"
+                return jax.jit(zeros, out_shardings=sh_dp)
+
             def zeros(params):
                 return {n: jnp.zeros(v.shape, v.dtype)
                         for n, v in params.items()}
             zeros.__name__ = "mxtpu_pp_zeros"
             return jax.jit(zeros, out_shardings=rep)
 
+        if kind == "gather":
+            # the stage's bucketed gradient reduction: one all-gather of
+            # the accumulated flat shards back to full-shape gradients,
+            # dispatched as soon as the stage's backward wave completes so
+            # the collective hides under the other slices' compute (the
+            # ZeRO update skips this — it consumes the shards directly)
+            def gather(params, acc):
+                out = {}
+                off = 0
+                for n, c in bucket_chunks(params):
+                    out[n] = _from_flat_shards(acc[:, off:off + c],
+                                               params[n].shape)
+                    off += c
+                return out
+            gather.__name__ = "mxtpu_pp_gather"
+            # the bucket is NOT donated: its (dp, chunk) layout can never
+            # back the replicated outputs (XLA would warn and ignore);
+            # __call__ drops its reference instead, freeing it on execute
+            return jax.jit(gather, out_shardings=rep)
+
         if kind == "upd":
-            names = list(stage.params)
             zero = self.zero
-            dp = self._dp
-            sh_dp = NamedSharding(sub, _pspec("dp"))
+            # ZeRO + overlap: the update consumes the flat (dp, chunk)
+            # gradient bucket directly — the reduce-scatters inside the
+            # backward wave already placed each device's shard, so the
+            # stage's dp communication is DONE when its backward finishes
+            bucket = overlap and zero
 
             def upd_math(params, grads, opt_state, hyper, t, rng):
+                gfs = None
+                if bucket:
+                    gfs, off = {}, 0
+                    for n, c in bucket_chunks(params):
+                        gfs[n] = jax.lax.with_sharding_constraint(
+                            grads[:, off:off + c], sh_dp)
+                        off += c
                 new_p, new_s = {}, {}
                 for n in names:
-                    g = grads[n].astype(params[n].dtype)
                     if zero:
-                        gf = jax.lax.with_sharding_constraint(
-                            _flat_shards(g, dp), sh_dp)
+                        if gfs is not None:
+                            gf = gfs[n].astype(params[n].dtype)
+                        else:
+                            g = grads[n].astype(params[n].dtype)
+                            gf = jax.lax.with_sharding_constraint(
+                                _flat_shards(g, dp), sh_dp)
                         wf = jax.lax.with_sharding_constraint(
                             _flat_shards(params[n], dp), sh_dp)
                         nwf, new_s[n] = self.fopt.update(
@@ -1519,6 +1742,7 @@ class PipelineTrainStep(object):
                         nw = _from_flat_shards(nwf, params[n].shape)
                         new_p[n] = jax.lax.with_sharding_constraint(nw, rep)
                     else:
+                        g = grads[n].astype(params[n].dtype)
                         new_p[n], new_s[n] = self.fopt.update(
                             n, params[n], g, opt_state[n], hyper, t,
                             rng=rng)
@@ -1528,8 +1752,11 @@ class PipelineTrainStep(object):
                 def upd(params, opt_state, acc, hyper, t, rng, finite,
                         inv):
                     def do(_):
-                        grads = {n: acc[n] * inv.astype(acc[n].dtype)
-                                 for n in acc}
+                        if bucket:
+                            grads = acc * inv.astype(acc.dtype)
+                        else:
+                            grads = {n: acc[n] * inv.astype(acc[n].dtype)
+                                     for n in acc}
                         return upd_math(params, grads, opt_state, hyper,
                                         t, rng)
 
@@ -1587,7 +1814,7 @@ class PipelineTrainStep(object):
         makes the runtime insert the device-to-device transfers."""
         import jax
         from jax.sharding import NamedSharding
-        sub = self._subs[s]
+        sub = self._sub(s)
         return tuple(jax.device_put(
             a, NamedSharding(sub, self._carry_spec(a, sub)))
             for a in arrs)
@@ -1595,7 +1822,7 @@ class PipelineTrainStep(object):
     def _put_batch(self, host, s):
         import jax
         from jax.sharding import NamedSharding
-        sub = self._subs[s]
+        sub = self._sub(s)
         return jax.device_put(host,
                               NamedSharding(sub, self._carry_spec(host,
                                                                   sub)))
@@ -1656,7 +1883,8 @@ class PipelineTrainStep(object):
 
     # ------------------------------------------------------------------ call
     def __call__(self, params, opt_state, aux, batch, rng=None):
-        """One pipelined, microbatched global step.  Returns
+        """One pipelined, microbatched global step under the configured
+        schedule (gpipe / 1f1b / interleaved).  Returns
         (params, opt_state, aux, outputs) — outputs are the loss heads
         over the full global batch (microbatch results concatenated in
         order)."""
@@ -1672,7 +1900,7 @@ class PipelineTrainStep(object):
                 "place_state/place_aux) before stepping")
         if rng is None:
             rng = _random.next_key()
-        M, S = self._micro, self._pp
+        M, P, V = self._micro, self._pp, self._V
         for n in self.data_names + self.label_names:
             if n not in batch:
                 raise MXNetError("pipeline step: missing input %s" % n)
@@ -1686,17 +1914,20 @@ class PipelineTrainStep(object):
             raise MXNetError(
                 "pipeline step: microbatch %d (batch %d / M=%d) is not "
                 "divisible by dp=%d" % (mb, b0, M, self._dp))
+        plan = self._get_plan()
         hyper = self.fopt.hyper(self.num_update)
         self.num_update += 1
         t = _np.int32(self.num_update)
         telem = _tel._enabled
-        busy = [0.0] * S if telem else None
+        busy = [0.0] * P if telem else None
         wall0 = _time.time() if telem else 0.0
         t0 = _time.perf_counter() if telem else 0.0
         args_led = (params, opt_state) + \
             ((self._scale_state_dev(),) if self._has_scale else ())
         if _san._donate_on:
             _san.check_donated("pipeline_step", self._donate_pairs(args_led))
+        nbytes = _tel.nbytes_of
+        gather_grads = self._overlap and not self.zero
         with _profiler.Scope("pipeline_step[%d]" % self.num_update,
                              "symbolic"), \
                 _san.hot_region("pipeline_step"):
@@ -1707,79 +1938,121 @@ class PipelineTrainStep(object):
                     for st in self._stages]
             aux_s = [{n: aux[n] for n in st.aux} for st in self._stages]
             aux_pre = [dict(a) for a in aux_s] if self._has_scale else None
-            acc = [self._timed(busy, s, self._get_prog("zeros", s), p_s[s])
-                   for s in range(S)]
-            # ---- forward wave: microbatch m enters stage s as soon as
-            # (m, s-1) and (m-1, s) are dispatched; stages live on
-            # disjoint device slices, so async dispatch realises the
-            # fill/steady/drain overlap
-            stash = [[None] * S for _ in range(M)]   # boundary activations
-            outs_m = [None] * M
-            for m in range(M):
-                c = ()
-                for s in range(S):
-                    st = self._stages[s]
-                    ex = {n: self._put_batch(batch[n][m * mb:(m + 1) * mb],
-                                             s)
-                          for n in st.inputs}
-                    cin = self._put_carry(c, s)
-                    stash[m][s] = (cin, ex)
-                    aux_new, o, c = self._timed(
-                        busy, s, self._get_prog("fwd", s),
-                        p_s[s], aux_s[s], cin, ex, rep_rngs[s],
-                        _np.int32(m))
-                    aux_s[s] = aux_new
-                outs_m[m] = o
-            # ---- backward wave (reverse order; per-stage accumulators
-            # donated through the wave)
+            acc = [self._timed(busy, k % P, self._get_prog("zeros", k),
+                               p_s[k]) for k in range(V)]
             scale_s = {}
             if self._has_scale:
-                # one scale transfer per loss-bearing stage (it cannot
-                # change during the wave), not one per microbatch
+                # one scale transfer per loss-bearing device slice (the
+                # scale cannot change during the waves), not one per
+                # microbatch — done up front because 1f1b/interleaved
+                # dispatch backwards before the forward wave drains
                 scale_op = self._scale_state["scale"]
-                scale_s = {s: (scale_op if s == S - 1 else
-                               self._put_carry((scale_op,), s)[0])
-                           for s in range(S) if self._stage_has_loss[s]}
-            for m in reversed(range(M)):
-                g = ()
-                for s in reversed(range(S)):
-                    cin, ex = stash[m][s]
-                    gout = self._put_carry(g, s)
-                    call = [p_s[s], cin, aux_s[s], ex, gout, acc[s],
-                            rep_rngs[s], _np.int32(m)]
-                    if s in scale_s:
-                        call.append(scale_s[s])
-                    g, acc[s] = self._timed(busy, s,
-                                            self._get_prog("bwd", s), *call)
-                stash[m] = None   # free this microbatch's boundary stash
+                sc_d = {}
+                for k in range(V):
+                    if not self._stage_has_loss[k]:
+                        continue
+                    d = k % P
+                    if d not in sc_d:
+                        sc_d[d] = scale_op if d == P - 1 else \
+                            self._put_carry((scale_op,), d)[0]
+                    scale_s[k] = sc_d[d]
+            # ---- dispatch the planned schedule: work items run on their
+            # virtual stage's device slice in dispatch order, slices
+            # overlap through XLA's async dispatch.  stash holds each
+            # in-flight microbatch's boundary activations from its
+            # forward until its backward — the per-slice peak is the
+            # schedule's activation-memory signature (gpipe: grows with
+            # M; 1f1b: bounded by pp).
+            stash = {}
+            fwd_carry = {}     # (m, consumer stage) -> activation tuple
+            bwd_carry = {}     # (m, consumer stage) -> cotangent tuple
+            outs_m = [None] * M
+            grads_full = [None] * V
+            stash_nb = [0] * P
+            peak_nb = [0] * P
+            last_bwd = plan["last_bwd"]
+            for i, (kind, m, k) in enumerate(plan["items"]):
+                d = k % P
+                st = self._stages[k]
+                if kind == "fwd":
+                    ex = {n: self._put_batch(batch[n][m * mb:(m + 1) * mb],
+                                             k)
+                          for n in st.inputs}
+                    cin = self._put_carry(fwd_carry.pop((m, k), ()), k)
+                    stash[(m, k)] = (cin, ex)
+                    stash_nb[d] += sum(nbytes(a) for a in cin) \
+                        + sum(nbytes(v) for v in ex.values())
+                    peak_nb[d] = max(peak_nb[d], stash_nb[d])
+                    aux_new, o, c = self._timed(
+                        busy, d, self._get_prog("fwd", k),
+                        p_s[k], aux_s[k], cin, ex, rep_rngs[d],
+                        _np.int32(m))
+                    aux_s[k] = aux_new
+                    if k == V - 1:
+                        outs_m[m] = o
+                    else:
+                        fwd_carry[(m, k + 1)] = c
+                else:
+                    cin, ex = stash.pop((m, k))
+                    gout = self._put_carry(bwd_carry.pop((m, k), ()), k)
+                    call = [p_s[k], cin, aux_s[k], ex, gout, acc[k],
+                            rep_rngs[d], _np.int32(m)]
+                    if k in scale_s:
+                        call.append(scale_s[k])
+                    g, acc[k] = self._timed(busy, d,
+                                            self._get_prog("bwd", k), *call)
+                    if k > 0:
+                        bwd_carry[(m, k - 1)] = g
+                    stash_nb[d] -= sum(nbytes(a) for a in cin) \
+                        + sum(nbytes(v) for v in ex.values())
+                    if gather_grads and i == last_bwd[k] and st.params:
+                        # the stage's backward wave is complete: issue its
+                        # bucketed gradient all-gather NOW, so the dp
+                        # collective overlaps the other slices' remaining
+                        # compute instead of waiting inside the update
+                        grads_full[k] = self._timed(
+                            busy, d, self._get_prog("gather", k),
+                            p_s[k], acc[k])
+                        acc[k] = None   # drop the bucket reference
             # ---- loss-scale automaton + combined finite flag, on device
-            fin_s = inv_s = None
+            fin_d = inv_d = None
             if self._has_scale:
-                fins = [self._timed(busy, s, self._get_prog("fin", s),
-                                    acc[s]) for s in range(S)]
+                fins = []
+                for k in range(V):
+                    src = acc[k]
+                    if gather_grads:
+                        src = grads_full[k] if grads_full[k] is not None \
+                            else {}
+                    fins.append(self._timed(busy, k % P,
+                                            self._get_prog("fin", k), src))
                 last = NamedSharding(self._subs[-1], _pspec())
                 fins_dev = tuple(jax.device_put(f, last) for f in fins)
                 new_lsc, finite, inv = self._timed(
-                    busy, S - 1, self._get_prog("scale", S - 1),
+                    busy, P - 1, self._get_prog("scale", V - 1),
                     self._scale_state, fins_dev)
                 self._scale_state = new_lsc
-                fin_s = [self._put_carry((finite,), s)[0]
-                         for s in range(S)]
-                inv_s = [self._put_carry((inv,), s)[0] for s in range(S)]
+                fin_d = [self._put_carry((finite,), d)[0]
+                         for d in range(P)]
+                inv_d = [self._put_carry((inv,), d)[0] for d in range(P)]
             # ---- per-stage optimizer update (ZeRO-1 shards over the
             # stage sub-mesh's dp axis); donated params/state
             new_params, new_state, new_aux = {}, {}, {}
-            for s in range(S):
-                call = [p_s[s], st_s[s], acc[s], hyper, t, rep_rngs[s]]
+            for k in range(V):
+                d = k % P
+                g_in = acc[k]
+                if gather_grads:
+                    g_in = grads_full[k] if grads_full[k] is not None \
+                        else {}
+                call = [p_s[k], st_s[k], g_in, hyper, t, rep_rngs[d]]
                 if self._has_scale:
-                    call += [fin_s[s], inv_s[s]]
-                np_s, ns_s = self._timed(busy, s,
-                                         self._get_prog("upd", s), *call)
-                a_s = aux_s[s]
-                if self._has_scale and self._stages[s].aux:
-                    a_s = self._timed(busy, s,
-                                      self._get_prog("auxsel", s),
-                                      fin_s[s], a_s, aux_pre[s])
+                    call += [fin_d[d], inv_d[d]]
+                np_s, ns_s = self._timed(busy, d,
+                                         self._get_prog("upd", k), *call)
+                a_s = aux_s[k]
+                if self._has_scale and self._stages[k].aux:
+                    a_s = self._timed(busy, d,
+                                      self._get_prog("auxsel", k),
+                                      fin_d[d], a_s, aux_pre[k])
                 new_params.update(np_s)
                 new_state.update(ns_s)
                 new_aux.update(a_s)
@@ -1794,26 +2067,37 @@ class PipelineTrainStep(object):
             _san.note_donated("pipeline_step",
                               self._donate_pairs(args_led),
                               step=self.num_update)
+        # live-byte accounting per device slice: parameters/optimizer
+        # state/aux resident on the slice plus the PEAK boundary stash the
+        # executed schedule held there — pure shape metadata, no syncs;
+        # exposed regardless of telemetry for the dryrun ladder
+        static_nb = [0] * P
+        for k in range(V):
+            st = self._stages[k]
+            nb = sum(nbytes(new_params[n]) for n in st.params)
+            nb += sum(nbytes(x) for n in st.params for x in new_state[n])
+            nb += sum(nbytes(new_aux[n]) for n in st.aux)
+            static_nb[k % P] += nb
+        self.last_live_bytes = [static_nb[d] + peak_nb[d]
+                                for d in range(P)]
         if telem:
-            frac = pipeline_bubble_fraction(S, M)
-            for s in range(S):
-                _tel.record_span("pp.stage", wall0, busy[s],
-                                 cat="pipeline", stage=s, microbatches=M)
+            frac = plan["bubble"]
+            for d in range(P):
+                _tel.record_span("pp.stage", wall0, busy[d],
+                                 cat="pipeline", stage=d, microbatches=M,
+                                 schedule=self._schedule)
             wall = _time.perf_counter() - t0
             _tel.record_span("pp.bubble", wall0, wall * frac,
-                             cat="pipeline", pp=S, microbatches=M)
+                             cat="pipeline", pp=P, microbatches=M,
+                             schedule=self._schedule, interleave=self._v)
             _tel.gauge("pp_bubble_fraction", frac)
-            for s in range(S):
-                st = self._stages[s]
-                nb = sum(_tel.nbytes_of(new_params[n]) for n in st.params)
-                nb += sum(_tel.nbytes_of(x) for n in st.params
-                          for x in new_state[n])
-                nb += sum(_tel.nbytes_of(new_aux[n]) for n in st.aux)
+            for d in range(P):
                 # stage in the NAME: the gauge registry (and everything
                 # reading it — /metrics, summaries, the fleet merge) is
                 # name-keyed last-write-wins, so a tagged single name
                 # would surface only the final stage's footprint
-                _tel.gauge("pp_stage%d_live_bytes" % s, nb, stage=s)
+                _tel.gauge("pp_stage%d_live_bytes" % d,
+                           self.last_live_bytes[d], stage=d)
             if self._has_scale and self._amp_emit \
                     and _tel.scalar_due(self.num_update):
                 scale_v, overflow = self.amp_stats()

@@ -380,8 +380,10 @@ def test_pp_telemetry_signals(tmp_path):
         stages = [e for e in evs if e.get("name") == "pp.stage"]
         bubbles = [e for e in evs if e.get("name") == "pp.bubble"]
         assert sorted(e["tags"]["stage"] for e in stages) == [0, 1, 2, 3]
+        assert all(e["tags"]["schedule"] == "gpipe" for e in stages)
         assert len(bubbles) == 1
-        assert bubbles[0]["tags"] == {"pp": 4, "microbatches": 4}
+        assert bubbles[0]["tags"] == {"pp": 4, "microbatches": 4,
+                                      "schedule": "gpipe", "interleave": 1}
         g = tel.gauges()
         assert g["pp_bubble_fraction"] == pytest.approx(
             pipeline_bubble_fraction(4, 4))

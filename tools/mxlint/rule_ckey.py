@@ -58,6 +58,15 @@ CACHES = (
     {"name": "PipelineTrainStep._progs",
      "key": ("mxnet_tpu/train.py", "PipelineTrainStep._get_prog"),
      "roots": (("mxnet_tpu/executor.py", "_Lowered.run"),)},
+    # the schedule dispatch-plan cache (schedule-v2 PR): pure host-side
+    # python —
+    # the work-item generators in parallel/schedule.py read no env — but
+    # its key carries trace_env_key() for contract uniformity with the
+    # stage-program cache the plan drives (the programs themselves are
+    # keyed by PipelineTrainStep._progs above)
+    {"name": "PipelineTrainStep._plans",
+     "key": ("mxnet_tpu/train.py", "PipelineTrainStep._get_plan"),
+     "roots": (("mxnet_tpu/parallel/schedule.py", "stage_orders"),)},
     {"name": "serving bucket-rung ladder",
      "key": ("mxnet_tpu/serving.py", "ServedModel._predictor"),
      "roots": ()},     # rung jits land in the executor cache (see above)

@@ -88,6 +88,12 @@ class Run(object):
         self.series = {}   # key -> [(step, value)] sorted, last-wins per step
         self.bench = {}    # metric name -> value (BENCH headline numbers)
         self.meta = None   # BENCH meta block, when present
+        # identity blocks per record group (e.g. the pipeline block's
+        # config: pp/dp/microbatches/schedule/interleave) and which bench
+        # metrics each group contributed — two runs whose identities
+        # differ are different experiments, not a regression pair
+        self.identity = {}
+        self.groups = {}
 
     def add_point(self, key, step, value):
         self.series.setdefault(key, []).append((int(step), float(value)))
@@ -143,13 +149,27 @@ def _load_bench(run, doc, path):
                 run.bench[str(k)] = float(v)
     # pipeline record (dryrun_multichip's pp ladder / a pipelined bench):
     # numeric fields are gated headline metrics — pp_bubble_fraction and
-    # the per-stage memory fields regress by going up (direction hints);
-    # the nested config block (pp/dp/microbatch identity) is not compared
+    # the per-stage memory/live-bytes fields regress by going up
+    # (direction hints); the nested config block is IDENTITY
+    # (pp/dp/microbatches/schedule/interleave) — never compared as a
+    # metric, and when it differs between two runs their pipeline metrics
+    # are reported as context only (a gpipe record vs a 1f1b record is a
+    # schedule change, not a regression pair)
     pipeline = rec.get("pipeline") if isinstance(rec, dict) else None
     if isinstance(pipeline, dict):
+        names = set()
         for k, v in pipeline.items():
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 run.bench[str(k)] = float(v)
+                names.add(str(k))
+        # a pp_* HEADLINE metric (the pp ladder records stamp their gated
+        # bubble there too) belongs to the same identity group
+        for name in run.bench:
+            if name.startswith("pp_"):
+                names.add(name)
+        run.groups["pipeline"] = names
+        if isinstance(pipeline.get("config"), dict):
+            run.identity["pipeline"] = dict(pipeline["config"])
     chained = (run.meta or {}).get("telemetry_scalars")
     if chained:
         for candidate in (chained,
@@ -290,12 +310,22 @@ def compare_runs(base, cand, threshold, overrides=None, metrics=None):
                                       cand.series[key],
                                       direction_of(key, overrides),
                                       threshold))
+    # bench metrics whose record-group identity differs between the runs
+    # (e.g. the pipeline config's schedule/interleave/pp/dp/microbatches)
+    # are different experiments: report as context, never gate
+    mismatched = set()
+    for group in set(base.groups) & set(cand.groups):
+        bid, cid = base.identity.get(group), cand.identity.get(group)
+        if bid is not None and cid is not None and bid != cid:
+            mismatched |= base.groups[group] & cand.groups[group]
     for name in sorted(set(base.bench) & set(cand.bench)):
         if metrics and name not in metrics:
             continue
+        identity_ok = name not in mismatched
         rec = {
             "metric": name,
-            "direction": direction_of(name, overrides) or "up",
+            "direction": (direction_of(name, overrides) or "up")
+            if identity_ok else None,
             "base_final": base.bench[name],
             "final": cand.bench[name],
             "final_delta": rel_delta(base.bench[name], cand.bench[name]),
@@ -303,6 +333,9 @@ def compare_runs(base, cand, threshold, overrides=None, metrics=None):
             "auc_delta": None,
             "points": (1, 1),
         }
+        if not identity_ok:
+            rec["note"] = "identity differs (config block) — not a " \
+                          "regression pair"
         rec["verdict"] = _verdict(rec, threshold)
         records.append(rec)
     # flagged metrics first, then by name — the headline reads top-down
@@ -327,7 +360,10 @@ def _val(v):
     return "%.6g" % v
 
 
-def render(base, comparisons, out=sys.stdout):
+def render(base, comparisons, out=None):
+    # call-time stdout: a def-time default freezes the stream installed
+    # at first import (pytest capture, redirection) — see telemetry_agg
+    out = sys.stdout if out is None else out
     out.write("Run comparison — baseline: %s\n" % base.label)
     if not comparisons:
         out.write("no candidate runs\n")

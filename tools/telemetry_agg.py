@@ -114,11 +114,18 @@ def fold_rank(events):
         if t == "span":
             span_durs[ev["name"]].append(ev.get("dur", 0.0))
             # pipeline stage spans additionally fold by their stage tag —
-            # the per-STAGE skew view (the pp analogue of per-rank skew)
+            # the per-STAGE skew view (the pp analogue of per-rank skew).
+            # The schedule tag folds into the key (stage@schedule) so a
+            # run that switched MXNET_PP_SCHEDULE mid-stream keeps its
+            # gpipe and 1f1b observations separate, and a SLOW STAGE
+            # verdict names the schedule it was observed under.
             if ev["name"] == "pp.stage" and \
                     (ev.get("tags") or {}).get("stage") is not None:
-                stage_durs[str(ev["tags"]["stage"])].append(
-                    ev.get("dur", 0.0))
+                tags = ev["tags"]
+                key = str(tags["stage"])
+                if tags.get("schedule"):
+                    key = "%s@%s" % (key, tags["schedule"])
+                stage_durs[key].append(ev.get("dur", 0.0))
         elif not has_summary:
             if t == "counter":
                 counters[ev["name"]] = ev.get("total", 0)
@@ -327,23 +334,43 @@ def stage_skew_report(per_rank, ratio=STRAGGLER_RATIO):
             merged[stage].extend(durs)
     if not merged:
         return {}
+    def _split(key):
+        # fold_rank keys pipeline spans "stage" or "stage@schedule"
+        stage, _, sched = key.partition("@")
+        return stage, (sched or None)
+
     table = {}
     for stage in sorted(merged, key=lambda s: (len(s), s)):
         durs = merged[stage]
         table[stage] = {"count": len(durs),
                         "mean": sum(durs) / len(durs),
                         "p50": percentile(durs, 0.50),
-                        "p99": percentile(durs, 0.99)}
+                        "p99": percentile(durs, 0.99),
+                        "schedule": _split(stage)[1]}
+    # skew is judged WITHIN one schedule group: a mid-run
+    # MXNET_PP_SCHEDULE toggle splits stages into stage@sched keys, and
+    # comparing a warmup-skewed small-sample group against the other
+    # schedule's steady state would fabricate a SLOW STAGE verdict; the
+    # reported verdict is the worst group's
     means = sorted((rec["mean"], stage) for stage, rec in table.items())
-    slowest_mean, slowest_stage = means[-1]
-    rest = [m for m, _ in means[:-1]] or [slowest_mean]
-    median_mean = percentile(rest, 0.5)
-    skew = slowest_mean / median_mean if median_mean else float("inf")
+    groups = {}
+    for m, stage in means:
+        groups.setdefault(_split(stage)[1], []).append((m, stage))
+    worst = None   # (skew, slowest_mean, slowest_stage, group size)
+    for g in groups.values():
+        g_mean, g_stage = g[-1]
+        rest = [m for m, _ in g[:-1]] or [g_mean]
+        median_mean = percentile(rest, 0.5)
+        sk = g_mean / median_mean if median_mean else float("inf")
+        if worst is None or (sk, g_mean) > worst[:2]:
+            worst = (sk, g_mean, g_stage, len(g))
+    skew, _, slowest_stage, group_n = worst
     return {
         "stages": table,
         "slowest_stage": slowest_stage,
+        "slowest_schedule": _split(slowest_stage)[1],
         "skew_ratio": skew,
-        "slow_stage": slowest_stage if (len(table) >= 2 and skew >= ratio)
+        "slow_stage": slowest_stage if (group_n >= 2 and skew >= ratio)
         else None,
     }
 
@@ -370,7 +397,11 @@ def aggregate(paths, skew_spans=SKEW_SPANS, ratio=STRAGGLER_RATIO):
     return merged
 
 
-def render(agg, out=sys.stdout):
+def render(agg, out=None):
+    # resolve sys.stdout at CALL time: a def-time default would freeze
+    # whatever stream was installed at first import (pytest capture,
+    # redirected stdout) and break every later caller once it closes
+    out = sys.stdout if out is None else out
     ranks = agg["ranks"]
     out.write("Fleet telemetry: %d rank file(s) (%s)\n"
               % (len(ranks), ", ".join("rank%s" % r for r in ranks)))
@@ -421,9 +452,12 @@ def render(agg, out=sys.stdout):
                       % (sname, rec["count"], rec["mean"] / _US_PER_MS,
                          rec["p50"] / _US_PER_MS, rec["p99"] / _US_PER_MS))
         verdict = "SLOW STAGE" if stage["slow_stage"] is not None else "ok"
-        out.write("  slowest stage: %s (%.2fx the median of the other "
+        sched = stage.get("slowest_schedule")
+        out.write("  slowest stage: %s%s (%.2fx the median of the other "
                   "stages) — %s\n"
-                  % (stage["slowest_stage"], stage["skew_ratio"], verdict))
+                  % (stage["slowest_stage"].partition("@")[0],
+                     " [schedule %s]" % sched if sched else "",
+                     stage["skew_ratio"], verdict))
 
     counters = agg["counters"]
     if counters:
