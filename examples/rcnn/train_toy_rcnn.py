@@ -117,8 +117,16 @@ def main():
         return float((pred.argmax(axis=1) == label).mean())
     metric = mx.metric.np(head_acc, name="accuracy",
                           allow_extra_outputs=True)
-    mod.fit(it, num_epoch=12, optimizer="sgd",
-            optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
+    # SGD(0.05, momentum 0.9) drove every fc1 unit negative within three
+    # epochs (fc_relu live fraction -> 0.0): the 4096-dim ROI-concat
+    # features give the fc head gradients ~64x the conv layers', so one
+    # global rate either kills the head (dead-ReLU collapse; the head
+    # then predicts the class-0 fraction 0.432 forever) or is too slow
+    # for the convs.  The runtime is faithful — the pin diverged; Adam's
+    # per-parameter scaling absorbs the imbalance and trains the head to
+    # ~0.98 across seeds in the same 12 epochs.
+    mod.fit(it, num_epoch=12, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-3,
                               "rescale_grad": 1.0 / batch},
             initializer=mx.initializer.Xavier(magnitude=2.0),
             eval_metric=metric)

@@ -246,7 +246,11 @@ def write_snapshot(dirname, job):
         from .parallel import dist
         # bounded: a peer that died mid-epoch surfaces as a loud writer
         # error on the next save()/wait() (and the launch supervisor is
-        # already tearing the world down), not an indefinite hang
+        # already tearing the world down), not an indefinite hang.
+        # COLL002 contract: the id carries BOTH the step and the
+        # process-global save sequence — a resumed run whose update
+        # counter restarted can reuse a step number, and barrier ids are
+        # single-use within a coordination-service lifetime.
         dist.coordination_barrier(
             "ckpt-%d-%d" % (manifest["step"], job.get("_seq", 0)),
             timeout_ms=300000)

@@ -282,6 +282,17 @@ def snapshot(reason, exc=None, extra=None):
             "recent_events": _tel.recent_events(RECENT_EVENTS),
         },
     }
+    try:
+        from . import sanitize as _san
+        if _san._collective_on:
+            # the collective checker's per-rank ledger tail: a stall or
+            # crash bundle then says which collective this rank stopped
+            # at (seq, kind, signature) — the post-mortem for a hung
+            # fleet (docs/static_analysis.md "collective checker")
+            bundle["collective"] = _san.collective_state()
+            bundle["collective_ledger"] = _san.ledger_tail()
+    except Exception:   # diagnostics must never add a second failure
+        pass
     if exc is not None:
         bundle["exception"] = {
             "type": type(exc).__name__,

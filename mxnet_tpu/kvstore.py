@@ -180,7 +180,11 @@ class KVStore(object):
         return self._num_workers
 
     def barrier(self):
-        """Global barrier (parity: kvstore.barrier → ps Postoffice barrier)."""
+        """Global barrier (parity: kvstore.barrier → ps Postoffice
+        barrier).  No explicit id: ``dist.barrier`` auto-sequences the
+        default, so repeated epoch barriers never reuse one (COLL002 —
+        barrier ids are single-use within a coordination-service
+        lifetime)."""
         if self.type.startswith("dist"):
             from .parallel import dist as _dist
             _dist.barrier()

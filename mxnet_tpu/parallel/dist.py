@@ -79,13 +79,51 @@ def num_workers():
     return jax.process_count()
 
 
-def barrier(name="kvstore"):
-    """Global barrier via the coordination service (parity: ps barrier)."""
+# default-barrier-id sequence: sync_global_devices tolerates a repeated
+# name, but a *distinct* id per use keeps the COLL002 contract uniform
+# across every barrier flavour (coordination-service ids are single-use)
+# and makes a hung barrier's ledger entry unambiguous.  Process-local,
+# but barriers are collective — every rank reaches the same call count,
+# so the generated names agree world-wide (the health_check idiom).
+_barrier_seq_lock = threading.Lock()
+_barrier_seq = [0]
+
+
+def barrier(name=None):
+    """Global DEVICE barrier (psum over all global devices; parity: ps
+    barrier).  ``name=None`` auto-derives a sequenced id so repeated
+    calls (the kvstore epoch barrier) never reuse one.  Main-thread
+    only by contract — see :func:`coordination_barrier` for the
+    thread-safe service barrier."""
     init_process_group()
     import jax
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    if jax.process_count() <= 1:
+        return
+    if name is None:
+        with _barrier_seq_lock:
+            _barrier_seq[0] += 1
+            name = "kvstore-%d" % _barrier_seq[0]
+    from jax.experimental import multihost_utils
+    from .. import sanitize as _san
+    with _san.collective_dispatch("barrier", name=name):
+        # exchange BEFORE waiting: two ranks arriving with different
+        # barrier names (or divergent dispatch histories) are named here
+        # instead of deadlocking inside the mismatched collective
+        _san.collective_sync("barrier:%s" % name)
         multihost_utils.sync_global_devices(name)
+
+
+def coordination_client():
+    """jax's coordination-service client, or None (single process, or a
+    jax upgrade moved the internal layout).  The ONE owner of this
+    fragile lookup — ``coordination_barrier`` and mxsan's hash-chain
+    exchange both ride it, so a breakage surfaces in both at once
+    instead of silently disabling one."""
+    try:
+        from jax._src import distributed as _jdist
+        return getattr(_jdist.global_state, "client", None)
+    except Exception:            # internal layout moved
+        return None
 
 
 def coordination_barrier(name, timeout_ms=600000):
@@ -100,27 +138,29 @@ def coordination_barrier(name, timeout_ms=600000):
     import jax
     if jax.process_count() <= 1:
         return
-    client = None
-    try:
-        from jax._src import distributed as _jdist
-        client = getattr(_jdist.global_state, "client", None)
-    except Exception:            # internal layout moved
-        client = None
-    if client is not None:
-        client.wait_at_barrier(name, timeout_ms)
-        return
-    if threading.current_thread() is not threading.main_thread():
-        # falling back to sync_global_devices would launch a device
-        # collective from a side thread, interleaving with in-flight
-        # training collectives — the exact deadlock this function exists
-        # to avoid.  Fail loudly instead (a jax upgrade moved the
-        # coordination client; fix the lookup above).
-        raise MXNetError(
-            "coordination_barrier: jax's coordination-service client is "
-            "unavailable in this jax version and the device-collective "
-            "fallback is unsafe off the main thread")
-    from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices(name)
+    client = coordination_client()
+    from .. import sanitize as _san
+    # device=False: the service barrier is thread-safe by design — the
+    # checkpoint writer thread meeting its peers here is the sanctioned
+    # pattern, not an off-main-thread violation
+    with _san.collective_dispatch("coordination_barrier", name=name,
+                                  device=False):
+        _san.collective_sync("coordination_barrier:%s" % name)
+        if client is not None:
+            client.wait_at_barrier(name, timeout_ms)
+            return
+        if threading.current_thread() is not threading.main_thread():
+            # falling back to sync_global_devices would launch a device
+            # collective from a side thread, interleaving with in-flight
+            # training collectives — the exact deadlock this function
+            # exists to avoid.  Fail loudly instead (a jax upgrade moved
+            # the coordination client; fix the lookup above).
+            raise MXNetError(
+                "coordination_barrier: jax's coordination-service client "
+                "is unavailable in this jax version and the device-"
+                "collective fallback is unsafe off the main thread")
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
 
 
 # --------------------------------------------------------------------------
@@ -198,19 +238,26 @@ def allreduce_arrays(arrays):
         # beat BEFORE entering the collective: a worker hanging inside it
         # stops beating, so the watchdog dump's stacks show the allreduce
         _diag.heartbeat(comm="dist.allreduce", narrays=len(arrays))
+    from .. import sanitize as _san
     from .. import telemetry as _tel
-    if _tel._enabled:
-        # the rank tag lets a merged event stream (not just per-rank files)
-        # attribute collective latency to its worker
-        with _tel.span("dist.allreduce", cat="comm", narrays=len(arrays),
-                       rank=jax.process_index()):
+    # ledger entry from shape metadata only (the mxsan no-sync
+    # discipline); the in-flight mark feeds the MXNET_SAN_COLL_TIMEOUT
+    # deadlock watchdog while the collective blocks
+    sig = _san.collective_sig(arrays) if _san._collective_on else None
+    with _san.collective_dispatch("dist.allreduce", sig=sig,
+                                  axes="worker"):
+        if _tel._enabled:
+            # the rank tag lets a merged event stream (not just per-rank
+            # files) attribute collective latency to its worker
+            with _tel.span("dist.allreduce", cat="comm",
+                           narrays=len(arrays), rank=jax.process_index()):
+                outs = reduce()
+                _tel.counter("dist_allreduce")
+                _tel.counter("dist_allreduce_bytes",
+                             sum(_tel.nbytes_of(a) for a in arrays))
+                jax.block_until_ready(outs)  # span reads collective time
+        else:
             outs = reduce()
-            _tel.counter("dist_allreduce")
-            _tel.counter("dist_allreduce_bytes",
-                         sum(_tel.nbytes_of(a) for a in arrays))
-            jax.block_until_ready(outs)   # span reads collective time
-    else:
-        outs = reduce()
     # outputs are replicated over the worker mesh; hand back this process's
     # shard so results compose with process-local arrays (stays on device)
     return [o.addressable_shards[0].data for o in outs]

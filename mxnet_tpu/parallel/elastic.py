@@ -75,11 +75,22 @@ def health_check(timeout=30.0, name="health"):
         barrier_name = "%s-%d" % (name, _health_generation[0])
 
         def _barrier():
-            try:
-                dist.barrier(barrier_name)
-                ok.set()
-            except Exception:
-                pass
+            from .. import sanitize as _san
+            # the ONE sanctioned off-main-thread device collective:
+            # bounded by the caller's join(timeout), generation-suffixed
+            # so a stale pending barrier can never pair with a newer one,
+            # and the caller treats a miss as fatal — declared to the
+            # mxsan collective checker the way planned syncs declare
+            # allow_sync.  Its static twin is the THR002 suppression on
+            # the dist.barrier call below.
+            with _san.allow_thread_collective(
+                    "health probe: bounded, generation-suffixed barrier"):
+                try:
+                    # mxlint: disable=THR002 bounded health probe by design — generation-suffixed id, caller join(timeout), False is fatal
+                    dist.barrier(barrier_name)
+                    ok.set()
+                except Exception:
+                    pass
 
         t = threading.Thread(target=_barrier, daemon=True)
         t.start()
@@ -315,9 +326,13 @@ def fit_elastic(module, train_data, prefix, num_epoch, eval_data=None,
             # device-collective barrier here could interleave with it.
             # The fit_elastic-call sequence number keeps the id unique
             # when one process runs several elastic fits in a lifetime
-            # (coordination barrier ids are single-use).
+            # (coordination barrier ids are single-use — COLL002).
+            # Bounded like the writer's ckpt barrier: a peer that died at
+            # the epoch boundary surfaces as a loud error here, not an
+            # indefinite hang (the launch supervisor restarts the world).
             dist.coordination_barrier("elastic-ckpt-%d-%d"
-                                      % (barrier_run, iter_no))
+                                      % (barrier_run, iter_no),
+                                      timeout_ms=300000)
 
     if cb is None:
         extra = []

@@ -59,6 +59,28 @@ Three checkers:
   silently ignores donation would ship the bug latent until the first
   run on one that honours it).
 
+* **COLLECTIVE** — the SPMD twin of the COLL lint family
+  (docs/static_analysis.md): every collective dispatch through the
+  ``parallel.dist`` wrappers (allreduce, ``barrier``,
+  ``coordination_barrier``) and the pipeline gradient gather records a
+  ledger entry ``(seq, kind, name, shape/dtype signature, mesh axes,
+  thread)`` — built from shape METADATA at dispatch, zero host syncs —
+  and folds it into a per-rank rolling hash chain.  The chains are
+  exchanged through the jax coordination service (key-value RPC, no
+  device collectives) at every barrier entry and every fit epoch
+  boundary; a mismatch names the FIRST divergent entry with a field
+  diff against the majority rank ("rank 2 seq 41: mxtpu_pp_gather[...]
+  where ranks 0,1,3 dispatched dist.allreduce[...]") *before* the world
+  hangs in the mismatched collective.  A device collective dispatched
+  off the main thread (the writer-thread deadlock
+  ``dist.coordination_barrier`` exists to avoid; THR002's dynamic twin)
+  is a named violation unless scoped by
+  :func:`allow_thread_collective`.  With ``MXNET_SAN_COLL_TIMEOUT=<s>``
+  set, a watchdog thread (the diagnostics armed-thread idiom) notices a
+  dispatch that stays in flight past the budget and dumps the ledger
+  tail into a diagnostics bundle — a hung fleet leaves a post-mortem
+  naming which rank stopped at which seq.
+
 ``stats()`` / ``violations()`` expose counters and the recent violation
 messages; under telemetry every cache miss also refreshes the
 ``jit_cache_size`` gauge from the registry (the sum of live entries
@@ -81,9 +103,11 @@ from . import telemetry as _tel
 __all__ = ["SanitizerError", "SanitizerWarning", "arm", "disarm", "armed",
            "register_cache", "hot_region", "allow_sync", "note_donated",
            "check_donated", "donated_entry", "total_cache_entries",
-           "caches", "stats", "violations", "reset"]
+           "caches", "stats", "violations", "reset", "note_collective",
+           "collective_dispatch", "collective_sync", "collective_sig",
+           "allow_thread_collective", "ledger_tail", "collective_state"]
 
-CHECKERS = ("recompile", "sync", "donate")
+CHECKERS = ("recompile", "sync", "donate", "collective")
 
 # per-kind default warmup budgets: the number of cache misses that count
 # as legitimate warmup (one epoch of compiles for the train-side caches,
@@ -110,12 +134,18 @@ class SanitizerWarning(UserWarning):
 
 
 _lock = threading.RLock()
+# arm/disarm serialization: NEVER hold ``_lock`` while joining the
+# collective watchdog thread (it takes ``_lock`` in its scan loop);
+# concurrent arm() calls serialize here instead so handler/patch
+# installs still cannot double-install
+_arm_lock = threading.RLock()
 _armed = frozenset()      # subset of CHECKERS
 _mode = "warn"
 # hot-path guards: one module-global bool read while disarmed
 _recompile_on = False
 _sync_on = False
 _donate_on = False
+_collective_on = False
 
 _CACHES = []              # list[_CacheHandle]
 _DONATED = {}             # id(leaf) -> (label, where, step, ref)
@@ -126,8 +156,9 @@ _RAW_COMPILES = {}        # (jit fun name, shapes signature) -> count
 # executors re-binding the same shapes legitimately recompile 'fwd')
 _REGISTERED_JIT_NAMES = set()
 _stats = {"recompile_violations": 0, "sync_violations": 0,
-          "donate_violations": 0, "sync_allowed": 0, "cache_misses": 0,
-          "raw_compiles": 0}
+          "donate_violations": 0, "collective_violations": 0,
+          "sync_allowed": 0, "cache_misses": 0, "raw_compiles": 0,
+          "collective_dispatches": 0, "collective_thread_allowed": 0}
 _violations = deque(maxlen=200)
 _tls = threading.local()
 _log_handler = None       # compile-log watcher state
@@ -143,6 +174,7 @@ def _state():
         st = _tls.st = type("_TlsState", (), {})()
         st.regions = []
         st.allow = 0
+        st.coll_ok = 0
     return st
 
 
@@ -563,6 +595,461 @@ def check_donated(where, labeled_leaves):
                 % (label, where))
 
 
+# ------------------------------------------------------- collective checker
+_COLL_KEEP = 4096         # ledger entries remembered per rank (FIFO)
+_COLL_TAIL = 64           # entries published at each hash-chain exchange
+# seconds to wait for a peer's exchange payload: >= the LARGEST bounded
+# barrier in the repo (coordination_barrier's 600 s default; the ckpt /
+# elastic epoch barriers bound at 300 s) — a legitimately slow rank-0
+# pre-barrier save must never turn into a false "never reached the
+# checkpoint" violation.  Deliberately NOT tied to
+# MXNET_SAN_COLL_TIMEOUT (the stall-watchdog budget): a tight deadlock
+# budget must not shrink exchange tolerance.
+_COLL_SYNC_DEFAULT = 600.0
+
+_coll_seq = 0             # total dispatches this process has recorded
+_coll_mseq = 0            # MAIN-thread dispatches only: the hash-chain
+                          # position, comparable across ranks (side
+                          # threads interleave nondeterministically, so
+                          # they must not shift the chained numbering)
+_coll_ledger = deque(maxlen=_COLL_KEEP)
+_coll_chain = "0" * 40    # rolling sha1 over the canonical entry stream
+_coll_xchg = 0            # exchange-point counter (agrees across ranks as
+                          # long as every rank reaches the same barriers /
+                          # epoch boundaries — which is what is checked)
+_coll_inflight = {}       # thread ident -> (entry, monotonic start)
+_coll_stalled = set()     # entry seqs already dumped (one bundle each)
+_coll_watch_thread = None
+_coll_watch_stop = None   # threading.Event while the watchdog runs
+_coll_client_warned = False
+
+
+def _coll_canon(entry):
+    """Canonical byte form of a ledger entry for the hash chain: the
+    dispatch identity only.  The thread name stays out (a local property
+    checked separately, not part of the cross-rank order contract) and
+    so does the global ledger seq (side-thread dispatches consume seqs
+    at rank-dependent points; the rolling hash already encodes order)."""
+    import json
+    return json.dumps([entry["kind"], entry["name"], entry["sig"],
+                       entry["axes"]],
+                      sort_keys=True, separators=(",", ":"))
+
+
+def _fmt_entry(entry):
+    parts = []
+    if entry.get("name") is not None:
+        parts.append("name=%s" % entry["name"])
+    if entry.get("sig") is not None:
+        parts.append("sig=%s" % (entry["sig"],))
+    if entry.get("axes") is not None:
+        parts.append("axes=%s" % entry["axes"])
+    return "%s[%s]" % (entry.get("kind"), ", ".join(parts))
+
+
+def collective_sig(arrays):
+    """Shape/dtype signature of a collective's payload, from metadata
+    only (never a device sync): ``("f32(8,4)", "i32(2,)")``."""
+    out = []
+    for a in arrays:
+        dt = str(getattr(a, "dtype", "?"))
+        dt = {"float32": "f32", "float64": "f64", "bfloat16": "bf16",
+              "float16": "f16", "int32": "i32", "int64": "i64",
+              "uint32": "u32", "bool": "b1"}.get(dt, dt)
+        shape = tuple(getattr(a, "shape", ()))
+        out.append("%s(%s)" % (dt, ",".join(str(d) for d in shape)))
+    return tuple(out)
+
+
+def note_collective(kind, name=None, sig=None, axes=None, device=True):
+    """Record one collective dispatch in the per-rank ledger and fold it
+    into the rolling hash chain.  ``device=True`` marks a DEVICE
+    collective (XLA program over device slices): dispatching one off the
+    main thread can interleave with in-flight training collectives and
+    deadlock the world — named here (THR002's dynamic twin) unless the
+    thread is scoped by :func:`allow_thread_collective`.
+    ``coordination_barrier`` passes ``device=False`` (service RPC, safe
+    from any thread).  Call sites guard with ``if _san._collective_on:``
+    or go through :func:`collective_dispatch`."""
+    import hashlib
+    global _coll_seq, _coll_mseq, _coll_chain
+    thread = threading.current_thread()
+    on_main = thread is threading.main_thread()
+    with _lock:
+        _coll_seq += 1
+        entry = {"seq": _coll_seq, "kind": kind, "name": name,
+                 "sig": sig, "axes": axes, "thread": thread.name}
+        if on_main:
+            # only MAIN-thread dispatches fold into the hash chain: the
+            # chain verifies the SPMD dispatch ORDER, and the async
+            # checkpoint writer's service barriers interleave with the
+            # main thread at nondeterministic points per rank (they pair
+            # by barrier id, not by order — that id uniqueness is
+            # COLL002's job).  Off-main entries still land in the
+            # ledger (and in the thread/timeout checks below).  mseq is
+            # the chain position — the rank-comparable numbering the
+            # exchange diff aligns on.
+            _coll_mseq += 1
+            entry["mseq"] = _coll_mseq
+            _coll_chain = hashlib.sha1(
+                (_coll_chain + _coll_canon(entry)).encode()).hexdigest()
+        _coll_ledger.append(entry)
+        _stats["collective_dispatches"] += 1
+    if _tel._enabled:
+        _tel.counter("collective_dispatches", kind=kind)
+        _tel.gauge("collective_ledger_seq", entry["seq"])
+    if device and thread is not threading.main_thread():
+        if _state().coll_ok:
+            with _lock:
+                _stats["collective_thread_allowed"] += 1
+        else:
+            _violation(
+                "collective",
+                "mxsan COLLECTIVE: device collective %s dispatched from "
+                "thread '%s' — an off-main-thread device collective can "
+                "interleave with in-flight training collectives and "
+                "deadlock the world; use dist.coordination_barrier "
+                "(service RPC, thread-safe) or scope a deliberately "
+                "bounded probe with sanitize.allow_thread_collective"
+                % (_fmt_entry(entry), thread.name))
+    return entry
+
+
+class _CollDispatch(object):
+    """In-flight marker around a blocking collective: entered dispatches
+    are what the MXNET_SAN_COLL_TIMEOUT watchdog watches."""
+
+    __slots__ = ("entry",)
+
+    def __init__(self, entry):
+        self.entry = entry
+
+    def __enter__(self):
+        import time
+        with _lock:
+            _coll_inflight[threading.get_ident()] = (self.entry,
+                                                     time.monotonic())
+        return self
+
+    def __exit__(self, *exc):
+        with _lock:
+            _coll_inflight.pop(threading.get_ident(), None)
+            self.entry["done"] = True
+        return False
+
+
+def collective_dispatch(kind, name=None, sig=None, axes=None, device=True):
+    """Note a collective dispatch AND mark it in flight for the dynamic
+    extent of the ``with`` block (barrier waits, blocking allreduces).
+    The shared no-op singleton while the checker is off."""
+    if not _collective_on:
+        return _NOOP
+    return _CollDispatch(note_collective(kind, name=name, sig=sig,
+                                         axes=axes, device=device))
+
+
+class _AllowThreadCollective(object):
+    __slots__ = ()
+
+    def __enter__(self):
+        _state().coll_ok += 1
+        return self
+
+    def __exit__(self, *exc):
+        _state().coll_ok -= 1
+        return False
+
+
+def allow_thread_collective(reason):
+    """Scoped escape hatch for a *deliberately* off-main-thread device
+    collective (elastic ``health_check``'s bounded, generation-suffixed
+    probe barrier).  Counted, never flagged; the reason documents the
+    protocol the same way ``allow_sync`` does."""
+    if not _collective_on:
+        return _NOOP
+    return _AllowThreadCollective()
+
+
+def ledger_tail(n=_COLL_TAIL):
+    """The last ``n`` ledger entries (copies — safe to serialize)."""
+    with _lock:
+        return [dict(e) for e in list(_coll_ledger)[-n:]]
+
+
+def collective_state():
+    """Snapshot for diagnostics bundles: chain position, in-flight
+    dispatches, exchange count."""
+    import time
+    with _lock:
+        inflight = [{"thread": tid, "age_sec": time.monotonic() - t0,
+                     "entry": dict(e)}
+                    for tid, (e, t0) in _coll_inflight.items()]
+        return {"seq": _coll_seq, "mseq": _coll_mseq,
+                "chain": _coll_chain, "exchanges": _coll_xchg,
+                "inflight": inflight}
+
+
+def _coll_payload():
+    """The exchanged summary: chain + the last MAIN-thread (chained)
+    entries, keyed by their chain position ``mseq`` — the numbering that
+    is comparable across ranks (global ledger seqs shift with
+    rank-local side-thread dispatches)."""
+    with _lock:
+        chained = [e for e in _coll_ledger if "mseq" in e]
+        return {"seq": _coll_mseq, "chain": _coll_chain,
+                "tail": [{"seq": e["mseq"], "kind": e["kind"],
+                          "name": e["name"], "sig": e["sig"],
+                          "axes": e["axes"]}
+                         for e in chained[-_COLL_TAIL:]]}
+
+
+def _divergence_message(point, n, rank, mine, peers):
+    """None when every rank's hash chain agrees; else a message naming
+    the first divergent ledger entry with a field diff against the
+    majority.  Pure — unit-testable with seeded payloads."""
+    chains = {rank: mine["chain"]}
+    chains.update({r: p["chain"] for r, p in peers.items()})
+    if len(set(chains.values())) == 1:
+        return None
+    by_chain = {}
+    for r, c in sorted(chains.items()):
+        by_chain.setdefault(c, []).append(r)
+    majority_chain = max(by_chain,
+                         key=lambda c: (len(by_chain[c]), by_chain[c]))
+    majority = by_chain[majority_chain]
+    minority = sorted(r for r in chains if r not in majority)
+    # diff one minority rank against one majority rank, by seq
+    all_payloads = dict(peers)
+    all_payloads[rank] = mine
+    a_rank = minority[0]
+    b_rank = majority[0]
+    a = {e["seq"]: e for e in all_payloads[a_rank]["tail"]}
+    b = {e["seq"]: e for e in all_payloads[b_rank]["tail"]}
+    head = ("mxsan COLLECTIVE: collective dispatch streams diverged at "
+            "checkpoint '%s' (exchange %d): " % (point, n))
+    a_min = min(a, default=0)
+    b_min = min(b, default=0)
+    for seq in sorted(set(a) | set(b)):
+        ea, eb = a.get(seq), b.get(seq)
+        if (ea is None and seq < a_min) or (eb is None and seq < b_min):
+            # below the other tail's publish window: the entry slid out
+            # of its 64-entry tail, which is NOT evidence that the rank
+            # skipped it — only seqs past a rank's MAX mean it stopped.
+            # Comparing here would blame whichever rank is merely ahead.
+            continue
+        if ea is None or eb is None:
+            who, last = (a_rank, b_rank) if ea is None else (b_rank, a_rank)
+            have = eb if ea is None else ea
+            return head + (
+                "rank %s dispatched nothing at seq %d where rank%s %s "
+                "dispatched %s — rank %s stopped at seq %d"
+                % (who, seq, "s" if len(by_chain[chains[who]]) > 1 else "",
+                   last, _fmt_entry(have), who,
+                   max(a if ea is None else b, default=0)))
+        if ea != eb:
+            fields = [k for k in ("kind", "name", "sig", "axes")
+                      if ea.get(k) != eb.get(k)]
+            return head + (
+                "rank %s seq %d: %s where rank%s %s dispatched %s — "
+                "field diff: %s"
+                % (a_rank, seq, _fmt_entry(ea),
+                   "s" if len(majority) > 1 else "",
+                   ",".join(str(r) for r in majority), _fmt_entry(eb),
+                   "; ".join("%s (%s -> %s)" % (k, _short(eb.get(k)),
+                                                _short(ea.get(k)))
+                             for k in fields)))
+    return head + (
+        "rank(s) %s hold chain %s.. against %s.. on rank(s) %s, but the "
+        "divergence is older than the last %d published entries (local "
+        "seq %d) — raise the exchange cadence or rerun from the start"
+        % (",".join(str(r) for r in minority), chains[a_rank][:12],
+           majority_chain[:12], ",".join(str(r) for r in majority),
+           _COLL_TAIL, mine["seq"]))
+
+
+def _coord_client():
+    # ONE owner for the fragile jax-internal lookup:
+    # parallel.dist.coordination_client (coordination_barrier rides the
+    # same helper, so a jax upgrade that moves the client breaks both
+    # loudly together instead of silently disabling one)
+    try:
+        from .parallel import dist as _dist
+        return _dist.coordination_client()
+    except Exception:
+        return None
+
+
+def collective_sync(point, timeout_s=None):
+    """Exchange the rolling hash chain with every peer rank through the
+    coordination service (key-value RPC — no device collectives, safe
+    from any thread) and name the first divergent dispatch on mismatch.
+    Called at every barrier entry (``dist.barrier`` /
+    ``coordination_barrier``) and at each fit epoch boundary; every rank
+    must reach the same exchange points in the same order — which is
+    exactly the property being verified, so a missing peer is itself a
+    named finding (with this rank's ledger position) instead of a hang.
+    No-op single-process and while the checker is off."""
+    global _coll_xchg, _coll_client_warned
+    if not _collective_on:
+        return
+    if threading.current_thread() is not threading.main_thread():
+        # exchanges must hit the same points in the same ORDER on every
+        # rank; a side thread (the async checkpoint writer at its ckpt
+        # barrier) interleaves nondeterministically with the main
+        # thread's exchanges, so it would desync the exchange counter
+        # and report false divergence.  Its dispatches stay visible in
+        # the ledger; the main thread's next exchange carries the chain.
+        return
+    import json
+    import jax
+    if jax.process_count() <= 1:
+        return
+    client = _coord_client()
+    if client is None:
+        with _lock:
+            warned, _coll_client_warned = _coll_client_warned, True
+        if not warned:
+            warnings.warn(
+                "mxsan COLLECTIVE: jax's coordination-service client is "
+                "unavailable in this jax version; hash-chain exchange "
+                "disabled (the ledger, thread and timeout checks still "
+                "run)", SanitizerWarning)
+        return
+    if timeout_s is None:
+        timeout_s = _COLL_SYNC_DEFAULT
+    with _lock:
+        _coll_xchg += 1
+        n = _coll_xchg
+    rank = jax.process_index()
+    # one encode: the published bytes, re-decoded for the local copy so
+    # the entry diff compares like with like (peers arrive JSON-decoded;
+    # tuples become lists)
+    raw = json.dumps(_coll_payload(), separators=(",", ":"))
+    mine = json.loads(raw)
+    try:
+        client.key_value_set("mxsan-coll/%d/%d" % (n, rank), raw)
+        if n > 2:
+            # reclaim this rank's exchange-(n-2) key: every peer that
+            # published n-1 (a prerequisite for anyone reaching n) had
+            # already finished reading the n-2 round, so the delete can
+            # never race a blocking get — without it a long fleet run
+            # grows the coordinator's KV store without bound
+            try:
+                client.key_value_delete("mxsan-coll/%d/%d"
+                                        % (n - 2, rank))
+            except Exception:
+                pass
+    except Exception as e:
+        _violation("collective",
+                   "mxsan COLLECTIVE: hash-chain publish failed at "
+                   "checkpoint '%s' (exchange %d): %s" % (point, n, e),
+                   raise_ok=False)
+        return
+    import time
+    peers, missing = {}, []
+    # ONE deadline across every peer read: k dead ranks must cost one
+    # timeout total, not k sequential timeouts (each surviving rank
+    # would otherwise sit k*timeout inside the barrier's pre-wait
+    # exchange while the stall watchdog fires on the enclosing dispatch)
+    deadline = time.monotonic() + timeout_s
+    for r in range(jax.process_count()):
+        if r == rank:
+            continue
+        left_ms = max(1, int((deadline - time.monotonic()) * 1000))
+        try:
+            raw = client.blocking_key_value_get(
+                "mxsan-coll/%d/%d" % (n, r), left_ms)
+            peers[r] = json.loads(raw)
+        except Exception:
+            missing.append(r)
+    if missing:
+        last = ledger_tail(3)
+        _violation(
+            "collective",
+            "mxsan COLLECTIVE: rank(s) %s never reached collective "
+            "checkpoint '%s' (exchange %d) within %.0fs — suspected "
+            "divergence or deadlock; this rank (%d) is at ledger seq %d"
+            "%s" % (",".join(str(r) for r in missing), point, n,
+                    timeout_s, rank, mine["seq"],
+                    (", last dispatches: "
+                     + "; ".join(_fmt_entry(e) for e in last))
+                    if last else ""))
+        return
+    msg = _divergence_message(point, n, rank, mine, peers)
+    if msg is not None:
+        _violation("collective", msg)
+
+
+# ---------------------------------------------- collective dispatch watchdog
+def _coll_watch_loop(stop, budget_s):
+    """Daemon watcher (the diagnostics armed-thread idiom): a dispatch
+    still in flight past the budget writes ONE diagnostics bundle with
+    the ledger tail — the post-mortem a hung fleet leaves behind."""
+    import sys as _sys
+    import time
+    poll = min(1.0, budget_s / 4.0)
+    while not stop.wait(poll):
+        try:
+            now = time.monotonic()
+            overdue = []
+            with _lock:
+                for tid, (entry, t0) in _coll_inflight.items():
+                    if now - t0 >= budget_s \
+                            and entry["seq"] not in _coll_stalled:
+                        _coll_stalled.add(entry["seq"])
+                        overdue.append((tid, entry, now - t0))
+            for tid, entry, age in overdue:
+                from . import diagnostics as _diag
+                path = _diag.write_snapshot(
+                    "collective_stall",
+                    extra={"collective_stall":
+                           {"entry": dict(entry), "age_sec": age,
+                            "timeout_sec": budget_s,
+                            "thread_ident": tid},
+                           "collective": collective_state(),
+                           "collective_ledger": ledger_tail()})
+                _sys.stderr.write(
+                    "mxsan COLLECTIVE: dispatch %s in flight for %.1fs "
+                    "(budget %.1fs) — suspected collective deadlock%s\n"
+                    % (_fmt_entry(entry), age, budget_s,
+                       "; ledger dumped to %s" % path if path else ""))
+                _sys.stderr.flush()
+                if _tel._enabled:
+                    _tel.counter("collective_stalls")
+        except Exception as e:   # a dump error must not kill the watch
+            try:
+                _sys.stderr.write(
+                    "mxsan COLLECTIVE: watchdog dump failed (%s)\n" % e)
+            except Exception:
+                pass
+
+
+def _start_coll_watchdog():
+    """Armed only when the collective checker is on AND
+    MXNET_SAN_COLL_TIMEOUT is set — plain ``MXNET_SAN=collective``
+    starts no thread (import-hygiene contract)."""
+    global _coll_watch_thread, _coll_watch_stop
+    budget = get_env("MXNET_SAN_COLL_TIMEOUT", None, typ=float)
+    if not budget or budget <= 0:
+        return
+    _coll_watch_stop = threading.Event()
+    _coll_watch_thread = threading.Thread(
+        target=_coll_watch_loop, args=(_coll_watch_stop, float(budget)),
+        name="mxsan-coll-watchdog", daemon=True)
+    _coll_watch_thread.start()
+
+
+def _stop_coll_watchdog():
+    global _coll_watch_thread, _coll_watch_stop
+    stop, t = _coll_watch_stop, _coll_watch_thread
+    _coll_watch_thread = None
+    _coll_watch_stop = None
+    if stop is not None:
+        stop.set()
+    if t is not None and t.is_alive():
+        t.join(timeout=5.0)
+
+
 # -------------------------------------------------------------- sync hooks
 def _install_hooks():
     """Patch the Python-level sync/read choke points.  Installed only on
@@ -675,7 +1162,8 @@ def arm(checkers="all", mode=None):
     (``"recompile,sync"``; may carry a trailing ``:raise``); ``mode`` is
     ``"warn"`` (default) or ``"raise"``.  Idempotent per configuration;
     warmup budgets count from the moment of arming."""
-    global _armed, _mode, _recompile_on, _sync_on, _donate_on
+    global _armed, _mode, _recompile_on, _sync_on, _donate_on, \
+        _collective_on
     if isinstance(checkers, str):
         parsed, spec_mode = _parse_spec(checkers)
     else:
@@ -687,25 +1175,29 @@ def arm(checkers="all", mode=None):
     mode = mode or spec_mode
     if mode not in ("warn", "raise"):
         raise MXNetError("sanitize.arm: mode must be 'warn' or 'raise'")
-    # the handler/patch installs happen UNDER the lock too: concurrent
+    # the handler/patch installs happen UNDER the arm lock: concurrent
     # arm() calls would otherwise double-install and disarm() would then
-    # leak one handler forever (none of the installs re-enter _lock)
-    with _lock:
+    # leak one handler forever (none of the installs re-enter it)
+    with _arm_lock:
         disarm()
         if not parsed:
             return False
-        _armed = frozenset(parsed)
-        _mode = mode
-        _recompile_on = "recompile" in _armed
-        _sync_on = "sync" in _armed
-        _donate_on = "donate" in _armed
-        for h in _CACHES:
-            h._miss_anchor = h._misses      # budgets count from arming
-            h._warned = 0
+        with _lock:
+            _armed = frozenset(parsed)
+            _mode = mode
+            _recompile_on = "recompile" in _armed
+            _sync_on = "sync" in _armed
+            _donate_on = "donate" in _armed
+            _collective_on = "collective" in _armed
+            for h in _CACHES:
+                h._miss_anchor = h._misses  # budgets count from arming
+                h._warned = 0
         if _recompile_on:
             _attach_compile_log()
         if _sync_on or _donate_on:
             _install_hooks()
+        if _collective_on:
+            _start_coll_watchdog()
     return True
 
 
@@ -713,13 +1205,17 @@ def disarm():
     """Restore every patched function / handler and return to the
     strict-no-op state.  Registered caches, their warm keys and the
     stats survive (the registry also feeds the jit_cache_size gauge)."""
-    global _armed, _mode, _recompile_on, _sync_on, _donate_on
-    with _lock:
-        _armed = frozenset()
-        _recompile_on = _sync_on = _donate_on = False
-        _mode = "warn"
+    global _armed, _mode, _recompile_on, _sync_on, _donate_on, \
+        _collective_on
+    with _arm_lock:
+        with _lock:
+            _armed = frozenset()
+            _recompile_on = _sync_on = _donate_on = _collective_on = False
+            _mode = "warn"
+            _coll_inflight.clear()
         _detach_compile_log()
         _remove_hooks()
+        _stop_coll_watchdog()
 
 
 def armed():
@@ -741,13 +1237,24 @@ def violations():
 
 def reset():
     """Zero the stats, violation log, donated-buffer registry, raw-jit
-    counts and every cache's miss anchor (test isolation)."""
+    counts, the collective ledger/hash chain and every cache's miss
+    anchor (test isolation)."""
+    global _coll_seq, _coll_mseq, _coll_chain, _coll_xchg, \
+        _coll_client_warned
     with _lock:
         for k in _stats:
             _stats[k] = 0
         _violations.clear()
         _DONATED.clear()
         _RAW_COMPILES.clear()
+        _coll_ledger.clear()
+        _coll_inflight.clear()
+        _coll_stalled.clear()
+        _coll_seq = 0
+        _coll_mseq = 0
+        _coll_chain = "0" * 40
+        _coll_xchg = 0
+        _coll_client_warned = False
         for h in _CACHES:
             h._miss_anchor = h._misses
             h._warned = 0

@@ -2010,6 +2010,15 @@ class PipelineTrainStep(object):
                         # bucketed gradient all-gather NOW, so the dp
                         # collective overlaps the other slices' remaining
                         # compute instead of waiting inside the update
+                        if _san._collective_on:
+                            # ledger entry at dispatch, from the bucket's
+                            # shape metadata (no sync): a rank whose
+                            # schedule diverges is named by stage + sig
+                            # at the next hash-chain exchange
+                            _san.note_collective(
+                                "mxtpu_pp_gather", name="stage%d" % k,
+                                sig=_san.collective_sig((acc[k],)),
+                                axes="dp")
                         grads_full[k] = self._timed(
                             busy, d, self._get_prog("gather", k),
                             p_s[k], acc[k])

@@ -1,0 +1,106 @@
+"""2-process clean run under ``MXNET_SAN=all:raise`` (collective checker
+armed): an elastic fit with per-epoch rank-0 monolithic checkpointing,
+mid-epoch sharded step checkpoints (async writer thread meeting its
+peers at the coordination barrier), a checkpoint load back, and the
+dist kvstore's fused all-reduces — every barrier entry and epoch
+boundary exchanges the collective hash chain, and the run must finish
+with ZERO sanitizer violations (the repo's collective surface holds the
+contracts the checker enforces).
+
+Run via the launcher (the wrapping test sets the env):
+    JAX_PLATFORMS=cpu MXNET_SAN=all:raise MXNET_CKPT_EVERY_N_STEPS=3 \
+        python tools/launch.py -n 2 \
+        python tests/python/dist/dist_collective_clean.py <workdir>
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+from mxnet_tpu.parallel import dist  # noqa: E402
+
+dist.init_process_group()
+
+import numpy as np  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sanitize as san  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+from mxnet_tpu.parallel import elastic  # noqa: E402
+
+
+def main():
+    assert san.armed() == frozenset(san.CHECKERS), san.armed()
+    workdir = sys.argv[1] if len(sys.argv) > 1 else "."
+    prefix = os.path.join(workdir, "collclean")
+    rank, world = dist.rank(), dist.num_workers()
+    rng = np.random.RandomState(0)
+    n, nc, dim = 200, 4, 16
+    centers = rng.randn(nc, dim) * 3
+    y = rng.randint(0, nc, n)
+    x = (centers[y] + rng.randn(n, dim)).astype(np.float32)
+    shard = slice(rank * n // world, (rank + 1) * n // world)
+    it = mx.io.NDArrayIter(x[shard], y[shard].astype(np.float32),
+                           batch_size=25)
+
+    mx.random.seed(7)
+    mod = mx.Module(models.get_mlp(num_classes=nc), context=mx.cpu())
+    # elastic fit: per-epoch mono checkpoint is rank-0-only with the
+    # peers at the epoch coordination barrier (the sanctioned COLL001
+    # shape), and MXNET_CKPT_EVERY_N_STEPS makes the async writer thread
+    # meet its peers at the ckpt coordination barrier — both exchange
+    # the hash chain on entry
+    elastic.fit_elastic(mod, it, prefix, num_epoch=3, kvstore="dist_tpu",
+                        optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1,
+                                          "momentum": 0.9})
+
+    # checkpoint restore (host-side; every rank loads the same files)
+    epoch = elastic.latest_checkpoint(prefix)
+    assert epoch == 3, epoch
+    sharded = None
+    try:
+        from mxnet_tpu import checkpoint as ckpt
+        sharded = ckpt.latest_sharded(prefix)
+        if sharded is not None:
+            man, params, opt_st, aux = ckpt.load_sharded(sharded)
+            assert params, "sharded checkpoint restored empty"
+    except Exception:
+        raise
+
+    # a couple of fused kvstore pushes + an explicit epoch barrier pair
+    kv = mx.kv.create("dist_sync")
+    kv.init(1, mx.nd.ones((4, 4)))
+    kv.push(1, mx.nd.ones((4, 4)) * (rank + 1))
+    kv.barrier()
+
+    # the async-checkpoint-writer shape: a SIDE THREAD meets its peers
+    # at a coordination-service barrier — ledger-visible, thread-legal
+    # (device=False), and never a false divergence (off-main dispatches
+    # stay out of the hash chain; exchanges are main-thread only)
+    import threading
+    err = []
+
+    def _writer():
+        try:
+            dist.coordination_barrier("writer-probe-1", timeout_ms=60000)
+        except Exception as e:   # surfaced by the assert below
+            err.append(e)
+
+    t = threading.Thread(target=_writer, daemon=True)
+    t.start()
+    t.join(60)
+    assert not t.is_alive() and not err, (t.is_alive(), err)
+
+    s = san.stats()
+    for k in ("collective_violations", "sync_violations",
+              "donate_violations", "recompile_violations"):
+        assert s[k] == 0, (k, s, san.violations())
+    assert s["collective_dispatches"] > 0
+    st = san.collective_state()
+    assert st["exchanges"] > 0, "hash chain never exchanged"
+    print("OK rank %d dispatches %d exchanges %d"
+          % (rank, s["collective_dispatches"], st["exchanges"]))
+
+
+if __name__ == "__main__":
+    main()

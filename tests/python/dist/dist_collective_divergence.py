@@ -1,0 +1,60 @@
+"""Seeded SPMD divergence for the mxsan collective checker (COLL001's
+dynamic twin): rank 1 is forced down a divergent branch — it dispatches
+an EXTRA all-reduce the other rank never issues — and then both ranks
+meet at a barrier.  Without the checker this is the classic silent SPMD
+hang (rank 0 waits in the barrier psum, rank 1 waits in its lone
+all-reduce, nobody ever errors).  With ``MXNET_SAN=collective:raise``
+the hash-chain exchange at the barrier ENTRY names the first divergent
+ledger entry and the run dies loudly instead of timing out.
+
+Run via the launcher:
+    JAX_PLATFORMS=cpu MXNET_SAN=collective:raise python tools/launch.py \
+        -n 2 python tests/python/dist/dist_collective_divergence.py
+
+Every rank prints ``DIVERGENCE <message>`` and exits 42 when the checker
+names the divergence (the wrapping test asserts the message and that no
+launcher timeout was needed).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+from mxnet_tpu.parallel import dist
+
+dist.init_process_group()  # before any backend-initialising call
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+from mxnet_tpu import sanitize as san  # noqa: E402
+
+
+def main():
+    rank = dist.rank()
+    # symmetric prologue: two fused all-reduces every rank dispatches
+    for _ in range(2):
+        outs = dist.allreduce_arrays([jax.device_put(
+            np.ones((4,), np.float32))])
+        np.testing.assert_allclose(np.asarray(outs[0]),
+                                   np.full((4,), dist.num_workers()))
+    if rank == 1:
+        # THE divergent branch: an extra collective the peers never
+        # dispatch.  The payload shape is distinct so the named field
+        # diff is unambiguous in the test assertion.
+        san.note_collective("dist.allreduce", sig=("f32(8,)",),
+                            axes="worker")
+    try:
+        # exchange at barrier entry: divergence must be NAMED here,
+        # before any collective can hang
+        dist.barrier("divergence-probe")
+    except san.SanitizerError as e:
+        print("DIVERGENCE %s" % e)
+        sys.stdout.flush()
+        sys.exit(42)
+    print("NO-DIVERGENCE rank %d" % rank)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

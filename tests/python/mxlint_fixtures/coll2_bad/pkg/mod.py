@@ -1,0 +1,14 @@
+"""COLL002 seeded violations: constant barrier ids from re-runnable
+functions (the PR 11 barrier-id-reuse bug as a fixture)."""
+from . import dist
+
+
+def epoch_end(module):
+    # called once per EPOCH: the second call re-arms the same id and a
+    # stale pending barrier can pair with it
+    dist.coordination_barrier("elastic-ckpt")
+
+
+def flush(writer):
+    # keyword form, same bug
+    dist.barrier(name="ckpt-flush")

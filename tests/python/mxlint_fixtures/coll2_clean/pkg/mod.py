@@ -1,0 +1,27 @@
+"""COLL002 clean twin: sequenced ids, and the two exempt shapes (module
+scope; a once-latched initializer)."""
+from . import dist
+
+_initialized = False
+
+# module scope runs once per import: a constant id is genuinely
+# single-use here
+dist.coordination_barrier("import-probe")
+
+
+def init_world():
+    global _initialized
+    if _initialized:
+        return
+    # once-latched (the init_process_group shape): runs once per process
+    dist.coordination_barrier("world-init")
+    _initialized = True
+
+
+def epoch_end(module, seq, epoch):
+    # the fix: a sequence component in the id
+    dist.coordination_barrier("elastic-ckpt-%d-%d" % (seq, epoch))
+
+
+def flush(writer, seq):
+    dist.barrier(name="ckpt-flush-%d" % seq)

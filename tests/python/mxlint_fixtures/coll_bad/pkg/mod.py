@@ -1,0 +1,36 @@
+"""COLL001 seeded violations: collectives only some ranks reach."""
+from . import dist
+
+
+def save_epoch(step, payload):
+    # rank-conditioned barrier with nothing matching on the other path:
+    # ranks != 0 never enter the barrier and the world deadlocks
+    if dist.rank() == 0:
+        write(step, payload)
+        dist.coordination_barrier("ckpt-%d" % step)
+
+
+def merge(step, arrays):
+    # rank read propagated through a local name, divergent collective
+    my_rank = dist.rank()
+    if my_rank == 0:
+        arrays = dist.allreduce_arrays(arrays)
+    return arrays
+
+
+def publish(step, payload):
+    # the early-return shape: ranks != 0 return before the barrier, so
+    # rank 0 waits in it forever
+    if _rank_id() != 0:
+        return None
+    out = write(step, payload)
+    dist.barrier("publish-%d" % step)
+    return out
+
+
+def _rank_id():
+    return dist.rank()
+
+
+def write(step, payload):
+    return payload

@@ -1,0 +1,54 @@
+"""COLL001 clean twin: every rank reaches a matching collective —
+including the sanctioned rank-0-writes-while-peers-barrier shape."""
+from . import dist
+
+
+def save_epoch(step, payload):
+    # THE sanctioned shape: rank 0 writes while its peers wait at the
+    # SAME barrier — both branches dispatch a matching collective
+    if dist.rank() == 0:
+        write(step, payload)
+        dist.barrier("ckpt-%d" % step)
+    else:
+        dist.barrier("ckpt-%d" % step)
+
+
+def save_epoch_hoisted(step, payload):
+    # equally fine: the barrier sits after the rank branch, reached by
+    # every rank unconditionally
+    if dist.rank() == 0:
+        write(step, payload)
+    dist.coordination_barrier("ckpt-%d" % step)
+
+
+def merge(step, arrays):
+    # rank used for bookkeeping only; the collective is unconditional
+    my_rank = dist.rank()
+    out = dist.allreduce_arrays(arrays)
+    return out if my_rank == 0 else list(out)
+
+
+def publish(step, payload):
+    # early return is fine when no collective follows it
+    if _rank_id() != 0:
+        return None
+    return write(step, payload)
+
+
+def _rank_id():
+    return dist.rank()
+
+
+def write(step, payload):
+    return payload
+
+
+def register_rank0_callback(step, registry):
+    # a closure merely DEFINED under the rank branch executes nothing
+    # there: its return (and any collective it wraps) belongs to the
+    # eventual caller, so the barrier below is reached by every rank
+    if dist.rank() == 0:
+        def _cb():
+            return write(step, None)
+        registry.append(_cb)
+    dist.barrier("register-%d" % step)

@@ -1,0 +1,38 @@
+"""THR002 seeded violations: device collectives on side threads."""
+import threading
+from concurrent import futures
+
+from . import dist
+
+
+def probe():
+    # closure Thread target launching a device barrier off-main
+    def _barrier():
+        dist.barrier("probe")
+
+    t = threading.Thread(target=_barrier, daemon=True)
+    t.start()
+
+
+class Writer(object):
+    """Device collective reached THROUGH the thread body (propagation:
+    _drain -> _flush)."""
+
+    def start(self):
+        self._t = threading.Thread(target=self._drain, daemon=True)
+        self._t.start()
+
+    def _drain(self):
+        self._flush()
+
+    def _flush(self):
+        dist.allreduce_arrays([1])
+
+
+def pooled(pool):
+    # concurrent.futures submission is a thread body too
+    return pool.submit(_reduce_on_pool, [1])
+
+
+def _reduce_on_pool(arrays):
+    return dist.allreduce_arrays(arrays)

@@ -1,0 +1,40 @@
+"""THR002 clean twin: side threads use the coordination-service barrier
+(no device programs — thread-safe by design), plus the one sanctioned
+device-collective probe carrying a documented suppression."""
+import threading
+from concurrent import futures
+
+from . import dist
+
+
+def probe(generation):
+    # the sanctioned shape: a deliberately bounded, generation-suffixed
+    # device barrier on a daemon thread — protocol documented inline
+    def _barrier():
+        # mxlint: disable=THR002 bounded health probe: generation-suffixed id, caller join(timeout)
+        dist.barrier("health-%d" % generation)
+
+    t = threading.Thread(target=_barrier, daemon=True)
+    t.start()
+    t.join(timeout=30.0)
+
+
+class Writer(object):
+    def start(self):
+        self._t = threading.Thread(target=self._drain, daemon=True)
+        self._t.start()
+
+    def _drain(self):
+        self._flush()
+
+    def _flush(self, seq=0):
+        # service RPC, no device collective: safe from any thread
+        dist.coordination_barrier("ckpt-%d" % seq)
+
+
+def pooled(pool, seq):
+    return pool.submit(_wait_on_pool, seq)
+
+
+def _wait_on_pool(seq):
+    dist.coordination_barrier("pool-%d" % seq)

@@ -39,6 +39,9 @@ CASES = [
     ("noop", "NOOP001", ("pkg",), "without an env guard"),
     ("thr", "THR001", ("pkg",), "lock-free"),
     ("ckey", "CKEY001", ("mxnet_tpu",), "cache key"),
+    ("coll", "COLL001", ("pkg",), "rank-dependent"),
+    ("coll2", "COLL002", ("pkg",), "single-use"),
+    ("thr2", "THR002", ("pkg",), "off-main-thread"),
 ]
 
 
@@ -137,6 +140,79 @@ def test_thr_module_scope_and_class_scope():
     findings, _, _ = run_fixture("thr_bad", "THR001", ("pkg",))
     assert any("attribute 'count'" in f.message for f in findings)
     assert any("global '_beats'" in f.message for f in findings)
+
+
+def test_coll_covers_both_divergence_classes():
+    """COLL001's two SPMD deadlock shapes: a collective under a
+    rank-dependent branch without a matching dispatch on the other path
+    (direct read AND name-taint propagation), and a collective made
+    unreachable by a rank-dependent early return."""
+    findings, _, _ = run_fixture("coll_bad", "COLL001", ("pkg",))
+    msgs = " / ".join(f.message for f in findings)
+    assert "never reach a matching dispatch" in msgs
+    assert "early return" in msgs
+    assert any(f.context == "merge" for f in findings)   # via name taint
+    assert any(f.context == "publish" for f in findings)
+
+
+def test_coll_sanctioned_rank0_save_shape_passes():
+    """The rank-0-writes-while-peers-barrier pattern is the sanctioned
+    shape: paired barriers in both branches, or the collective hoisted
+    after the rank branch — the clean twin carries both and must not
+    fire."""
+    findings, _, errors = run_fixture("coll_clean", "COLL001", ("pkg",))
+    assert not errors
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_coll2_exempts_module_scope_and_once_latch():
+    """COLL002's two exemptions — module scope (one run per import) and
+    the once-latched init_process_group shape — live in the clean twin;
+    the bad twin fires on both the positional and keyword name forms."""
+    findings, _, _ = run_fixture("coll2_bad", "COLL002", ("pkg",))
+    assert any("'elastic-ckpt'" in f.message for f in findings)
+    assert any("'ckpt-flush'" in f.message for f in findings)
+    clean, _, _ = run_fixture("coll2_clean", "COLL002", ("pkg",))
+    assert clean == [], [str(f) for f in clean]
+
+
+def test_thr2_seeds_closures_methods_and_submissions():
+    """THR002's three thread-body seeds: a nested closure Thread target,
+    a self-method target with propagation one call deep, and a
+    concurrent.futures submission."""
+    findings, _, _ = run_fixture("thr2_bad", "THR002", ("pkg",))
+    ctxs = {f.context for f in findings}
+    assert "probe._barrier" in ctxs
+    assert "Writer._flush" in ctxs            # _drain -> _flush
+    assert "_reduce_on_pool" in ctxs          # pool.submit
+    # coordination_barrier (service RPC) is exempt — the clean twin's
+    # writer thread uses it freely
+    clean, suppressed, _ = run_fixture("thr2_clean", "THR002", ("pkg",))
+    assert clean == []
+    assert len(suppressed) == 1               # the documented probe
+
+
+def test_multi_rule_module_filters_to_selected_rule():
+    """rule_coll hosts COLL001+COLL002; selecting one must not leak the
+    other's findings (core's multi-rule filtering)."""
+    f1, _, _ = run_fixture("coll2_bad", "COLL001", ("pkg",))
+    assert f1 == [], [str(f) for f in f1]
+    f2, _, _ = run_fixture("coll2_bad", "COLL002", ("pkg",))
+    assert f2 and all(f.rule == "COLL002" for f in f2)
+
+
+def test_repo_health_probe_is_the_one_thr2_suppression():
+    """The repo's single sanctioned off-main-thread device collective:
+    elastic health_check's bounded probe barrier, suppressed with its
+    protocol — and nothing else."""
+    from tools.mxlint.core import Project
+    from tools.mxlint import rule_thr2
+    p = Project(ROOT)
+    findings = rule_thr2.run(p)
+    assert [(f.rel, f.context) for f in findings] == \
+        [("mxnet_tpu/parallel/elastic.py", "health_check._barrier")]
+    fi = p.file("mxnet_tpu/parallel/elastic.py")
+    assert fi.suppressed("THR002", findings[0].line)
 
 
 # ---------------------------------------------------------------- machinery

@@ -572,6 +572,43 @@ def test_fit_elastic_step_interval_and_midepoch_resume(tmp_path,
                                    atol=1e-5, err_msg=k)
 
 
+def test_interval_save_and_resume_sanitizer_all_raise(tmp_path,
+                                                      monkeypatch):
+    """Acceptance leg: the checkpoint save (async writer, batched
+    device_get) and the sharded resume run CLEAN under the FULL
+    sanitizer — MXNET_SAN=all:raise now includes the collective checker,
+    so the writer path must hold the ledger/thread contracts too."""
+    from mxnet_tpu import sanitize as san
+    monkeypatch.setenv("MXNET_CKPT_EVERY_N_STEPS", "3")
+    x, y = _blob_data()
+    kw = dict(optimizer="sgd",
+              optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+
+    def iter_():
+        return mx.io.NDArrayIter(x, y, batch_size=30)
+
+    san.arm("all", mode="raise")
+    san.reset()
+    try:
+        prefix = str(tmp_path / "sanck")
+        mx.random.seed(11)
+        m1 = mx.Module(_elastic_mlp(), context=mx.cpu())
+        elastic.fit_elastic(m1, iter_(), prefix, num_epoch=2, **kw)
+        assert ckpt.latest_sharded(prefix) is not None
+        # a rerun resumes from the newest checkpoint — load, crc verify,
+        # re-place, continue training — still fully sanitized
+        mx.random.seed(11)
+        m2 = mx.Module(_elastic_mlp(), context=mx.cpu())
+        elastic.fit_elastic(m2, iter_(), prefix, num_epoch=3, **kw)
+        s = san.stats()
+        for k in ("collective_violations", "sync_violations",
+                  "donate_violations", "recompile_violations"):
+            assert s[k] == 0, (k, s, san.violations())
+    finally:
+        san.disarm()
+        san.reset()
+
+
 def test_fit_elastic_resume_at_different_topology(tmp_path, monkeypatch):
     """Preemption-safe world resize: checkpoints written under MXNET_PP=2
     restore into a respawn WITHOUT pipeline stages (a shrunk world) —

@@ -43,3 +43,63 @@ def test_dist_data_parallel_training():
     """2-process data-parallel training converges and replicas stay in
     lockstep (parity: tests/nightly/dist_lenet.py, shrunk)."""
     _run_dist_script("dist_mlp.py")
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(300)
+def test_collective_divergence_dies_named_not_hung(tmp_path):
+    """THE mxsan collective acceptance: rank 1 forced down a divergent
+    branch (an extra all-reduce its peer never dispatches) → the
+    hash-chain exchange at the next barrier ENTRY names the first
+    divergent ledger entry (rank, seq, kind, field diff) and every rank
+    exits loudly — well before any collective timeout could fire."""
+    import time
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_SAN"] = "collective:raise"
+    env.pop("XLA_FLAGS", None)
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", sys.executable,
+         os.path.join(ROOT, "tests", "python", "dist",
+                      "dist_collective_divergence.py")],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=280)
+    elapsed = time.time() - t0
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 42, out[-3000:]
+    assert out.count("DIVERGENCE") == 2, out[-3000:]      # both ranks
+    assert "mxsan COLLECTIVE" in out
+    assert "diverged at checkpoint 'barrier:divergence-probe'" in out
+    assert "seq 3" in out and "field diff" in out
+    assert "dist.allreduce[sig=['f32(8,)']" in out        # the named extra
+    assert "NO-DIVERGENCE" not in out
+    # "before the hang": named divergence, not a timeout — the whole
+    # world (2 jax inits included) dies in well under the barrier bound
+    assert elapsed < 240, elapsed
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(300)
+def test_collective_checker_clean_on_elastic_fit_and_checkpoint(tmp_path):
+    """The dual acceptance: a 2-process elastic fit (dist kvstore
+    all-reduces, rank-0 epoch checkpointing behind the coordination
+    barrier, checkpoint load-back, a writer-thread service barrier) runs
+    CLEAN under MXNET_SAN=all:raise, with the hash chain exchanged at
+    every barrier/epoch."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_SAN"] = "all:raise"
+    env["MXNET_CKPT_EVERY_N_STEPS"] = "3"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", sys.executable,
+         os.path.join(ROOT, "tests", "python", "dist",
+                      "dist_collective_clean.py"), str(tmp_path)],
+        env=env, cwd=ROOT, capture_output=True, text=True, timeout=280)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    assert out.count("OK rank") == 2, out[-3000:]
+    assert "exchanges 7" in out    # 3 epoch ends + 3 ckpt barriers + kv

@@ -64,6 +64,17 @@ assert not hasattr(jax.device_get, "_mxsan_orig"), "jax patched"
 assert logging.getLogger("jax._src.interpreters.pxla").handlers == [], \
     "compile-log handler installed"
 
+# the collective checker's arming machinery must be absent with
+# MXNET_SAN unset: no ledger growth possible (hot guard off), no
+# watchdog thread, and the dispatch entry points degrade to the shared
+# no-op singleton
+_san = mxnet_tpu.sanitize
+assert _san._collective_on is False, "collective checker armed"
+assert _san._coll_watch_thread is None, "collective watchdog thread"
+assert _san.collective_dispatch("barrier", name="probe") \
+    is _san.hot_region("x"), "collective dispatch not the no-op singleton"
+assert _san.collective_state()["seq"] == 0, "ledger grew while disarmed"
+
 new_threads = [t.name for t in threading.enumerate()
                if t.ident not in baseline_threads]
 print("RESULT " + json.dumps({"threads": new_threads, **created}))

@@ -523,15 +523,29 @@ def test_run_compare_schedule_identity_not_regression_pair(tmp_path):
 
 # -------------------------------------------------------------------- mxsan
 def test_v2_sanitizer_clean_and_plan_cache():
-    san.arm("recompile,sync,donate", mode="raise")
+    # "all" now includes the collective checker: the v2 overlap path's
+    # bucketed gather must ride a FULLY sanitized run clean, and its
+    # dispatches land in the collective ledger (stage-named, dp axis)
+    san.arm("all", mode="raise")
+    san.reset()
     try:
         before = dict(san.stats())
         ts, p, s, a, _ = _pp_steps(_mlp(), _mlp_batch(), MLP_SHAPES, 2,
                                    dp=2, M=2, n=3, schedule="1f1b")
         after = san.stats()
         for k in ("sync_violations", "donate_violations",
-                  "recompile_violations"):
+                  "recompile_violations", "collective_violations"):
             assert after[k] == before.get(k, 0), (k, after)
+        gathers = [e for e in san.ledger_tail(4096)
+                   if e["kind"] == "mxtpu_pp_gather"]
+        assert gathers, "overlap gather never reached the ledger"
+        assert gathers[0]["axes"] == "dp"
+        assert gathers[0]["name"].startswith("stage")
+        # the sig must carry the REAL flat-bucket shape (dp, chunk) —
+        # "f32(2,...)" — not a degenerate "?()" (a rank with divergent
+        # gather payloads is named by exactly this field)
+        import re as _re
+        assert _re.match(r"f32\(2,\d+\)$", gathers[0]["sig"][0]), gathers
         plans = [c for c in san.caches()
                  if c["name"] == "pipeline.schedule"]
         assert plans and plans[0]["entries"] == 1

@@ -481,3 +481,329 @@ def test_suite_executes_under_sanitizer_raise_mode():
                           capture_output=True, text=True, timeout=550)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "SAN_E2E_OK" in proc.stdout
+
+
+# ------------------------------------------------------- collective checker
+def test_collective_spec_and_all_includes_it():
+    assert san.arm("collective:raise")
+    assert san.armed() == frozenset({"collective"})
+    assert san._collective_on and san._mode == "raise"
+    san.disarm()
+    san.arm("all")
+    assert "collective" in san.armed()
+
+
+def test_collective_ledger_records_dispatch_identity():
+    """Every entry carries (seq, kind, name, sig, axes, thread) — the
+    shared model both lint and runtime layers hang off."""
+    san.arm("collective")
+    san.reset()
+    san.note_collective("dist.allreduce", sig=("f32(4,2)", "i32(8,)"),
+                        axes="worker")
+    with san.collective_dispatch("barrier", name="ep-0"):
+        st = san.collective_state()
+        assert len(st["inflight"]) == 1   # marked while blocking
+    tail = san.ledger_tail()
+    assert [e["seq"] for e in tail] == [1, 2]
+    assert tail[0]["kind"] == "dist.allreduce"
+    assert tail[0]["sig"] == ("f32(4,2)", "i32(8,)")
+    assert tail[0]["axes"] == "worker"
+    assert tail[1] == dict(tail[1], kind="barrier", name="ep-0")
+    assert tail[0]["thread"] == "MainThread"
+    st = san.collective_state()
+    assert st["seq"] == 2 and st["inflight"] == []
+
+
+def test_collective_sig_is_metadata_only():
+    import jax
+    x = jax.numpy.ones((4, 2), dtype="float32")
+    assert san.collective_sig([x]) == ("f32(4,2)",)
+    import numpy as _np
+    assert san.collective_sig([_np.zeros(3, _np.int64)]) == ("i64(3)",)
+
+
+def test_collective_hash_chain_deterministic_and_order_sensitive():
+    """Two ranks issuing the SAME dispatch stream produce the same
+    chain; any reorder/extra entry diverges it — the exchangeable
+    summary the coordination service carries."""
+    san.arm("collective")
+    san.reset()
+    san.note_collective("dist.allreduce", sig=("f32(4,)",), axes="worker")
+    san.note_collective("barrier", name="ep-0")
+    c1 = san.collective_state()["chain"]
+    san.reset()
+    san.note_collective("dist.allreduce", sig=("f32(4,)",), axes="worker")
+    san.note_collective("barrier", name="ep-0")
+    assert san.collective_state()["chain"] == c1
+    san.reset()
+    san.note_collective("barrier", name="ep-0")
+    san.note_collective("dist.allreduce", sig=("f32(4,)",), axes="worker")
+    assert san.collective_state()["chain"] != c1
+
+
+def _payload(entries, chain):
+    return {"seq": max((e["seq"] for e in entries), default=0),
+            "chain": chain,
+            "tail": [dict({"name": None, "sig": None, "axes": None}, **e)
+                     for e in entries]}
+
+
+def test_collective_divergence_names_seq_and_field_diff():
+    """The headline message: first divergent seq, kind/name/sig/axes
+    field diff, minority vs majority ranks."""
+    mine = _payload([
+        {"seq": 40, "kind": "dist.allreduce", "sig": ["f32(4,)"],
+         "axes": "worker"},
+        {"seq": 41, "kind": "mxtpu_pp_gather", "name": "stage3",
+         "sig": ["f32(2048,)"], "axes": "dp"}], "aaa")
+    peer = _payload([
+        {"seq": 40, "kind": "dist.allreduce", "sig": ["f32(4,)"],
+         "axes": "worker"},
+        {"seq": 41, "kind": "dist.allreduce", "sig": ["f32(8,)"],
+         "axes": "worker"}], "bbb")
+    msg = san._divergence_message("barrier:x", 7, 2, mine,
+                                  {0: peer, 1: peer, 3: peer})
+    assert "rank 2 seq 41" in msg
+    assert "mxtpu_pp_gather[name=stage3" in msg
+    assert "ranks 0,1,3 dispatched dist.allreduce" in msg
+    assert "kind ('dist.allreduce' -> 'mxtpu_pp_gather')" in msg
+    assert "sig (['f32(8,)'] -> ['f32(2048,)'])" in msg
+
+
+def test_collective_divergence_names_stopped_rank():
+    """A rank missing an entry at a seq (it stopped dispatching) is
+    named with where it stopped."""
+    mine = _payload([{"seq": 5, "kind": "barrier", "name": "ep-1"}], "aa")
+    peer = _payload([], "bb")
+    msg = san._divergence_message("epoch1", 2, 0, mine, {1: peer})
+    assert "dispatched nothing at seq 5" in msg
+    assert "barrier[name=ep-1]" in msg
+
+
+def test_collective_agreement_is_silent():
+    mine = _payload([{"seq": 1, "kind": "barrier", "name": "x"}], "same")
+    assert san._divergence_message("p", 1, 0, mine,
+                                   {1: dict(mine)}) is None
+
+
+def test_collective_off_main_thread_named_and_escape_scoped():
+    """THR002's dynamic twin: a device collective noted from a side
+    thread is a named violation; allow_thread_collective scopes the one
+    sanctioned probe; coordination_barrier (device=False) is free."""
+    import threading
+    san.arm("collective")
+    san.reset()
+    caught = []
+
+    def t_bad():
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            san.note_collective("barrier", name="x")
+            caught.extend(str(x.message) for x in w
+                          if issubclass(x.category, san.SanitizerWarning))
+
+    th = threading.Thread(target=t_bad)
+    th.start()
+    th.join()
+    assert len(caught) == 1
+    assert "from thread" in caught[0] and "allow_thread_collective" \
+        in caught[0]
+
+    clean = []
+
+    def t_ok():
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            with san.allow_thread_collective("bounded probe"):
+                san.note_collective("barrier", name="y")
+            san.note_collective("coordination_barrier", name="z",
+                                device=False)
+            clean.extend(str(x.message) for x in w)
+
+    th = threading.Thread(target=t_ok)
+    th.start()
+    th.join()
+    assert clean == [], clean
+    s = san.stats()
+    assert s["collective_violations"] == 1
+    assert s["collective_thread_allowed"] == 1
+
+
+def test_collective_sync_noop_single_process():
+    """One process, nothing to exchange — and no exchange counter
+    drift."""
+    san.arm("collective")
+    san.reset()
+    san.collective_sync("epoch0")
+    assert san.collective_state()["exchanges"] == 0
+
+
+def test_collective_telemetry_signals_and_strict_noop_off():
+    """collective_dispatches counter + collective_ledger_seq gauge under
+    telemetry; zero events with telemetry off."""
+    san.arm("collective")
+    san.reset()
+    telemetry.start()
+    try:
+        san.note_collective("dist.allreduce", sig=("f32(2,)",),
+                            axes="worker")
+        san.note_collective("barrier", name="b-1")
+        c = telemetry.counters()
+        assert c.get("collective_dispatches") == 2
+        assert telemetry.gauges().get("collective_ledger_seq") == 2
+    finally:
+        telemetry.stop()
+    before = telemetry.counters()
+    san.note_collective("barrier", name="b-2")
+    assert telemetry.counters() == before     # telemetry off: no events
+
+
+def test_collective_disarm_is_strict_noop_and_stops_watchdog(tmp_path):
+    """Disarm restores the no-op state: guard off, watchdog joined,
+    in-flight cleared — and the entry points return the shared no-op."""
+    os.environ["MXNET_SAN_COLL_TIMEOUT"] = "30"
+    try:
+        san.arm("collective")
+        assert san._coll_watch_thread is not None
+        assert san._coll_watch_thread.is_alive()
+        san.disarm()
+        assert san._collective_on is False
+        assert san._coll_watch_thread is None
+        assert san.collective_dispatch("barrier") is san.hot_region("x")
+        assert san.allow_thread_collective("r") is san.hot_region("x")
+    finally:
+        os.environ.pop("MXNET_SAN_COLL_TIMEOUT", None)
+
+
+def test_collective_watchdog_dumps_ledger_on_stuck_dispatch(tmp_path):
+    """A dispatch in flight past MXNET_SAN_COLL_TIMEOUT writes ONE
+    diagnostics bundle embedding the ledger tail and the stuck entry —
+    the hung-fleet post-mortem."""
+    import glob
+    import json
+    import time
+    os.environ["MXNET_SAN_COLL_TIMEOUT"] = "0.3"
+    os.environ["MXNET_DIAG_DIR"] = str(tmp_path)
+    try:
+        san.arm("collective")
+        san.reset()
+        san.note_collective("dist.allreduce", sig=("f32(4,)",),
+                            axes="worker")
+        with san.collective_dispatch("barrier", name="hung-1"):
+            deadline = time.time() + 15
+            bundles = []
+            while time.time() < deadline and not bundles:
+                bundles = glob.glob(
+                    str(tmp_path / "mxtpu_diag.collective_stall*"))
+                time.sleep(0.05)
+        assert bundles, "watchdog never dumped"
+        with open(bundles[0]) as f:
+            b = json.load(f)
+        stall = b["extra"]["collective_stall"]
+        assert stall["entry"]["kind"] == "barrier"
+        assert stall["entry"]["name"] == "hung-1"
+        kinds = [e["kind"] for e in b["extra"]["collective_ledger"]]
+        assert kinds == ["dist.allreduce", "barrier"]
+        # one bundle per stall (the incident set dedupes)
+        time.sleep(0.8)
+        assert len(glob.glob(
+            str(tmp_path / "mxtpu_diag.collective_stall*"))) == 1
+    finally:
+        os.environ.pop("MXNET_SAN_COLL_TIMEOUT", None)
+        os.environ.pop("MXNET_DIAG_DIR", None)
+
+
+def test_diagnostics_bundle_embeds_ledger_while_armed(tmp_path):
+    """Any diagnostics bundle (crash/stall) carries the collective
+    ledger while the checker is armed — and tools/diagnose.py renders
+    it."""
+    import io
+    import json
+    from mxnet_tpu import diagnostics as diag
+    san.arm("collective")
+    san.reset()
+    san.note_collective("mxtpu_pp_gather", name="stage1",
+                        sig=("f32(64,)",), axes="dp")
+    os.environ["MXNET_DIAG_DIR"] = str(tmp_path)
+    try:
+        path = diag.write_snapshot("probe")
+    finally:
+        os.environ.pop("MXNET_DIAG_DIR", None)
+    with open(path) as f:
+        b = json.load(f)
+    assert b["collective"]["seq"] == 1
+    assert b["collective_ledger"][0]["kind"] == "mxtpu_pp_gather"
+    if ROOT_DIR not in sys.path:
+        sys.path.insert(0, ROOT_DIR)
+    from tools.diagnose import render, load_bundle
+    out = io.StringIO()
+    render(load_bundle(path), out=out)
+    text = out.getvalue()
+    assert "Collective ledger" in text
+    assert "mxtpu_pp_gather" in text and "stage1" in text
+
+
+ROOT_DIR = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def test_collective_chain_immune_to_side_thread_interleave():
+    """THE false-divergence regression pin: two ranks with identical
+    MAIN-thread dispatch streams must hash identically even when their
+    async-writer (side-thread) service barriers land at different
+    points — side threads pair by barrier id, not order, so they stay
+    out of the chain and out of the chained (mseq) numbering."""
+    import threading
+    san.arm("collective")
+
+    def side_barrier(n):
+        def _b():
+            san.note_collective("coordination_barrier", name="ckpt-%d" % n,
+                                device=False)
+        t = threading.Thread(target=_b)
+        t.start()
+        t.join()
+
+    # "rank 0": writer barrier between the two main dispatches
+    san.reset()
+    san.note_collective("dist.allreduce", sig=("f32(4,)",), axes="worker")
+    side_barrier(1)
+    san.note_collective("barrier", name="ep-0")
+    st0 = san.collective_state()
+    # "rank 1": writer barrier after both main dispatches
+    san.reset()
+    san.note_collective("dist.allreduce", sig=("f32(4,)",), axes="worker")
+    san.note_collective("barrier", name="ep-0")
+    side_barrier(1)
+    st1 = san.collective_state()
+    assert st0["chain"] == st1["chain"]
+    assert st0["mseq"] == st1["mseq"] == 2
+    assert st0["seq"] == st1["seq"] == 3      # ledger still sees all 3
+    # and the exchanged payload aligns on the chained numbering
+    p = san._coll_payload()
+    assert [e["seq"] for e in p["tail"]] == [1, 2]
+    assert all(e["kind"] != "coordination_barrier" or True
+               for e in p["tail"])
+    assert len(p["tail"]) == 2                # side entry not published
+
+
+def test_collective_divergence_skips_slid_window_edges():
+    """Window-edge regression pin: when both ranks' published tails are
+    FULL and seq-offset (one rank dispatched an extra entry long ago),
+    the seqs below a tail's minimum are not evidence — the diff must
+    come from the overlapping range (a field diff), never a
+    self-contradictory 'rank N dispatched nothing / stopped at a LATER
+    seq' blaming the rank that is ahead."""
+    # rank 2 (mine) is one ahead: window 3..5; peer's window 2..4
+    mine = _payload([
+        {"seq": 3, "kind": "dist.allreduce", "sig": ["f32(8,)"]},
+        {"seq": 4, "kind": "barrier", "name": "ep-1"},
+        {"seq": 5, "kind": "dist.allreduce", "sig": ["f32(4,)"]}], "aaa")
+    peer = _payload([
+        {"seq": 2, "kind": "dist.allreduce", "sig": ["f32(4,)"]},
+        {"seq": 3, "kind": "dist.allreduce", "sig": ["f32(4,)"]},
+        {"seq": 4, "kind": "dist.allreduce", "sig": ["f32(4,)"]}], "bbb")
+    msg = san._divergence_message("epoch2", 9, 2, mine, {0: peer})
+    assert "dispatched nothing at seq 2" not in msg
+    assert "seq 3" in msg and "field diff" in msg
+    assert "sig (['f32(8,)'] -> ['f32(4,)'])" in msg

@@ -35,6 +35,17 @@ def load_bundle(path):
     return bundle
 
 
+def _fmt_coll(entry, with_kind=True):
+    """Render one mxsan collective-ledger entry."""
+    parts = []
+    for k in ("name", "sig", "axes", "thread"):
+        v = entry.get(k)
+        if v is not None:
+            parts.append("%s=%s" % (k, v))
+    body = ", ".join(parts)
+    return "%s[%s]" % (entry.get("kind"), body) if with_kind else body
+
+
 def _fmt_ts(ts):
     try:
         return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
@@ -85,6 +96,25 @@ def render(bundle, out=sys.stdout, events=10, stacks=True):
             for line in t.get("stack", []):
                 for sub in line.splitlines():
                     out.write("     %s\n" % sub)
+
+    coll = bundle.get("collective") or (bundle.get("extra") or {}).get(
+        "collective")
+    ledger = bundle.get("collective_ledger") \
+        or (bundle.get("extra") or {}).get("collective_ledger") or []
+    if coll or ledger:
+        out.write("\nCollective ledger (mxsan)\n")
+        if coll:
+            out.write("  seq %s  exchanges %s  chain %s..\n"
+                      % (coll.get("seq"), coll.get("exchanges"),
+                         str(coll.get("chain"))[:12]))
+            for inf in coll.get("inflight") or []:
+                e = inf.get("entry") or {}
+                out.write("  IN FLIGHT %6.1fs  seq %-6s %s\n"
+                          % (inf.get("age_sec", 0.0), e.get("seq"),
+                             _fmt_coll(e)))
+        for e in ledger[-16:]:
+            out.write("    seq %-6s %-22s %s\n"
+                      % (e.get("seq"), e.get("kind"), _fmt_coll(e, False)))
 
     tel = bundle.get("telemetry") or {}
     counters = tel.get("counters") or {}

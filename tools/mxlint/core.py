@@ -81,13 +81,22 @@ def _discover(root, targets):
 
 
 def all_rules():
+    """rule id -> module.  A module may host several related rule ids by
+    exposing ``RULES`` (``rule_coll`` carries COLL001 + COLL002 — they
+    share the collective-site model); single-rule modules expose
+    ``RULE``."""
     from . import (rule_jit, rule_sync, rule_env, rule_noop, rule_thread,
-                   rule_ckey)
-    return {m.RULE: m for m in (rule_jit, rule_sync, rule_env, rule_noop,
-                                rule_thread, rule_ckey)}
+                   rule_ckey, rule_coll, rule_thr2)
+    table = {}
+    for m in (rule_jit, rule_sync, rule_env, rule_noop, rule_thread,
+              rule_ckey, rule_coll, rule_thr2):
+        for rid in getattr(m, "RULES", (m.RULE,)):
+            table[rid] = m
+    return table
 
 
-ALL_RULES = ("JIT001", "SYNC001", "ENV001", "NOOP001", "THR001", "CKEY001")
+ALL_RULES = ("JIT001", "SYNC001", "ENV001", "NOOP001", "THR001", "CKEY001",
+             "COLL001", "COLL002", "THR002")
 
 
 def lint(root, targets=DEFAULT_TARGETS, rules=None,
@@ -97,10 +106,19 @@ def lint(root, targets=DEFAULT_TARGETS, rules=None,
     separately so tooling can count them)."""
     project = Project(root, targets=targets, doc_path=doc_path)
     table = all_rules()
-    findings, suppressed = [], []
-    for rid in (rules or ALL_RULES):
+    selected = list(rules or ALL_RULES)
+    # a multi-rule module runs ONCE; its findings are filtered to the
+    # selected rule ids so ``--rules COLL001`` never leaks COLL002
+    mods = []
+    for rid in selected:
         mod = table[rid]
+        if mod not in mods:
+            mods.append(mod)
+    findings, suppressed = [], []
+    for mod in mods:
         for f in mod.run(project):
+            if f.rule not in selected:
+                continue
             fi = project.file(f.rel)
             if fi is not None and fi.suppressed(f.rule, f.line):
                 suppressed.append(f)

@@ -1,0 +1,326 @@
+"""COLL001 / COLL002 — SPMD collective-consistency (static half; the
+mxsan ``collective`` checker is the runtime twin, sharing one model of
+"collective dispatch site").
+
+**COLL001 — rank-divergent collective reach.**  Every rank of an SPMD
+world must dispatch the same collectives in the same order; a collective
+that only *some* ranks reach deadlocks the world with no diagnosis (the
+hang is in whichever collective the other ranks blocked on).  The rule
+flags a collective/barrier dispatch site that is conditionally reached
+based on the process *rank*:
+
+  * an ``if`` whose test depends on rank — a read of ``dist.rank()`` /
+    ``jax.process_index()`` / the ``MXTPU_PROCESS_ID`` env var, a call
+    to a same-file function that (transitively) performs such a read
+    (JIT001-style propagation), or a local name assigned from one —
+    with a collective in one branch and no *matching* collective in the
+    other;
+  * a rank-dependent branch that ``return``s early, with collectives
+    dispatched later in the same function (ranks taking the early
+    return never reach them).
+
+The sanctioned rank-0-writes-while-peers-wait shape passes via an
+explicit paired-barrier: both branches dispatch the same multiset of
+collective callees (``if rank == 0: save(); barrier(n) else:
+barrier(n)``), or the collective sits *after* the rank branch where
+every rank reaches it.  Anything else needs a triaged suppression
+naming the protocol.
+
+**COLL002 — reusable barrier ids.**  Coordination-service barrier ids
+are single-use within a service lifetime: a function that can run more
+than once per process and passes a *constant* name to ``barrier`` /
+``coordination_barrier`` / ``sync_global_devices`` re-arms the same id
+(the PR 11 barrier-id-reuse bug, now a rule).  The name expression must
+carry a non-constant sequence component (``"ckpt-%d-%d" % (step,
+seq)``).  Module-scope calls and functions protected by a module-global
+once-latch (the ``init_process_group`` shape: ``if _initialized:
+return``) are exempt — they genuinely run once.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import astutil
+from .core import Finding
+
+RULE = "COLL001"
+RULES = ("COLL001", "COLL002")
+
+# dotted-name tails that dispatch (or enter) a collective/barrier — the
+# static mirror of the runtime ledger's dispatch points
+COLLECTIVE_TAILS = {
+    "allreduce", "allreduce_arrays", "allreduce_tree", "barrier",
+    "coordination_barrier", "sync_global_devices", "wait_at_barrier",
+    "ppermute", "psum", "psum_scatter", "all_gather", "all_to_all",
+}
+
+# barrier flavours whose NAME argument is a single-use id (COLL002)
+BARRIER_TAILS = {"barrier", "coordination_barrier", "sync_global_devices",
+                 "wait_at_barrier"}
+
+# dotted tails whose call yields this process's rank
+RANK_CALL_TAILS = {"rank", "_rank", "_rank_id", "process_index"}
+
+RANK_ENV_VARS = {"MXTPU_PROCESS_ID"}
+
+
+def _tail(dotted):
+    return dotted.rsplit(".", 1)[-1] if dotted else ""
+
+
+def _is_rank_read(fi, node, rank_funcs):
+    """Direct rank source: a rank call, a rank env read, or a call to a
+    same-file function that transitively reads rank."""
+    if isinstance(node, ast.Call):
+        d = fi.dotted(node.func)
+        if _tail(d) in RANK_CALL_TAILS:
+            return True
+        # same-file propagation: bare name or self.method
+        t = _call_qualnames(fi, node)
+        if t & rank_funcs:
+            return True
+    if astutil.is_env_read(fi, node):
+        return astutil.env_read_var(fi, node) in RANK_ENV_VARS
+    return False
+
+
+def _call_qualnames(fi, call):
+    """Same-file qualname candidates for a call's target (bare name,
+    ``self.m`` with the enclosing class, nested-def resolution)."""
+    f = call.func
+    name = None
+    if isinstance(f, ast.Name):
+        name = f.id
+    elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "self":
+        name = f.attr
+    if name is None:
+        return set()
+    out = set()
+    for q in fi.functions():
+        if q == name or q.endswith("." + name):
+            out.add(q)
+    return out
+
+
+def _rank_funcs(fi):
+    """Same-file functions that (transitively) read the process rank —
+    calling one inside a branch condition makes the branch
+    rank-dependent (the JIT001 propagation idea, reversed)."""
+    funcs = fi.functions()
+    ranky = set()
+    for q, node in funcs.items():
+        for n in ast.walk(node):
+            if _is_rank_read(fi, n, frozenset()):
+                ranky.add(q)
+                break
+    changed = True
+    while changed:
+        changed = False
+        for q, node in funcs.items():
+            if q in ranky:
+                continue
+            for n in ast.walk(node):
+                if isinstance(n, ast.Call) \
+                        and _call_qualnames(fi, n) & ranky:
+                    ranky.add(q)
+                    changed = True
+                    break
+    return ranky
+
+
+def _tainted_names(fi, fn_node, rank_funcs):
+    """Local names assigned from a rank-source expression."""
+    out = set()
+    for n in ast.walk(fn_node):
+        if not isinstance(n, ast.Assign):
+            continue
+        if any(_is_rank_read(fi, v, rank_funcs)
+               for v in ast.walk(n.value)):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _rank_dependent(fi, test, rank_funcs, tainted):
+    for n in ast.walk(test):
+        if _is_rank_read(fi, n, rank_funcs):
+            return True
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                and n.id in tainted:
+            return True
+    return False
+
+
+def _walk_branch(nodes):
+    """Walk the statements of one branch, EXCLUDING nested function
+    bodies: a closure merely *defined* under a rank branch executes
+    nothing there — its returns/collectives belong to whoever calls it,
+    not to the branch."""
+    for root in nodes:
+        inner = {n for sub in ast.walk(root)
+                 if isinstance(sub, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))
+                 for n in ast.walk(sub) if n is not sub}
+        for n in ast.walk(root):
+            if n not in inner:
+                yield n
+
+
+def _collective_calls(fi, nodes):
+    """(call node, tail) for every collective dispatch executed by the
+    branch itself."""
+    out = []
+    for n in _walk_branch(nodes):
+        if isinstance(n, ast.Call):
+            t = _tail(fi.dotted(n.func))
+            if t in COLLECTIVE_TAILS:
+                out.append((n, t))
+    return out
+
+
+def _has_return(nodes):
+    return any(isinstance(n, ast.Return) for n in _walk_branch(nodes))
+
+
+def _coll001(fi, findings):
+    funcs = fi.functions()
+    rank_funcs = _rank_funcs(fi)
+    seen = set()          # (line,) dedupe across nested rank branches
+    for q, fn in sorted(funcs.items()):
+        nested = {n for sub in ast.walk(fn)
+                  if isinstance(sub, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                  and sub is not fn for n in ast.walk(sub)}
+        tainted = _tainted_names(fi, fn, rank_funcs)
+        early_exit_line = None     # end line of the first rank-dep return
+        for node in ast.walk(fn):
+            if node in nested or not isinstance(node, ast.If):
+                continue
+            if not _rank_dependent(fi, node.test, rank_funcs, tainted):
+                continue
+            body_calls = _collective_calls(fi, node.body)
+            else_calls = _collective_calls(fi, node.orelse)
+            body_tails = sorted(t for _, t in body_calls)
+            else_tails = sorted(t for _, t in else_calls)
+            if body_tails != else_tails:
+                from collections import Counter
+                bc, ec = Counter(body_tails), Counter(else_tails)
+                for calls, own, other, side in ((body_calls, bc, ec,
+                                                 "taken"),
+                                                (else_calls, ec, bc,
+                                                 "not taken")):
+                    for call, t in calls:
+                        if own[t] <= other[t] or call.lineno in seen:
+                            continue
+                        seen.add(call.lineno)
+                        findings.append(Finding(
+                            RULE, fi.rel, call.lineno, q,
+                            "collective %s is dispatched only when the "
+                            "rank-dependent branch at line %d is %s — "
+                            "ranks on the other path never reach a "
+                            "matching dispatch and the world deadlocks; "
+                            "pair it with a matching collective on the "
+                            "other branch (the rank-0-save shape), move "
+                            "it after the branch, or document the "
+                            "protocol with a suppression"
+                            % (fi.dotted(call.func) or t, node.lineno,
+                               side)))
+            if _has_return(node.body) or _has_return(node.orelse):
+                end = getattr(node, "end_lineno", node.lineno)
+                if early_exit_line is None or end < early_exit_line:
+                    early_exit_line = end
+                    early_exit_if = node.lineno
+        if early_exit_line is None:
+            continue
+        for n in ast.walk(fn):
+            if n in nested or not isinstance(n, ast.Call):
+                continue
+            t = _tail(fi.dotted(n.func))
+            if t in COLLECTIVE_TAILS and n.lineno > early_exit_line \
+                    and n.lineno not in seen:
+                seen.add(n.lineno)
+                findings.append(Finding(
+                    RULE, fi.rel, n.lineno, q,
+                    "collective %s is unreachable for ranks taking the "
+                    "rank-dependent early return at line %d — the "
+                    "remaining ranks deadlock waiting for them; hoist "
+                    "the collective above the return, make the return "
+                    "unconditional, or document the protocol with a "
+                    "suppression"
+                    % (fi.dotted(n.func) or t, early_exit_if)))
+
+
+# --------------------------------------------------------------- COLL002
+def _constant_expr(node):
+    """True when the expression has no runtime-varying component."""
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Name, ast.Attribute, ast.Call,
+                          ast.Subscript)):
+            return False
+    return True
+
+
+def _barrier_name_arg(call):
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "name":
+            return kw.value
+    return None
+
+
+def _once_guarded(fi, fn_node):
+    """The ``init_process_group`` shape: a module-global latch tested at
+    the top (``if _initialized: return``) makes the body run once per
+    process — its barrier ids genuinely are single-use."""
+    globals_declared = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Global):
+            globals_declared.update(n.names)
+    if not globals_declared:
+        return False
+    for st in fn_node.body:
+        if isinstance(st, ast.If) and len(st.body) == 1 \
+                and isinstance(st.body[0], ast.Return):
+            for n in ast.walk(st.test):
+                if isinstance(n, ast.Name) and n.id in globals_declared:
+                    return True
+    return False
+
+
+def _coll002(fi, findings):
+    for n in ast.walk(fi.tree):
+        if not isinstance(n, ast.Call):
+            continue
+        d = fi.dotted(n.func)
+        if _tail(d) not in BARRIER_TAILS:
+            continue
+        name_arg = _barrier_name_arg(n)
+        if name_arg is None or not _constant_expr(name_arg):
+            continue
+        ctx = fi.context_of(n)
+        if ctx == "<module>":
+            continue            # module scope runs once per import
+        fn = fi.functions().get(ctx)
+        if fn is not None and _once_guarded(fi, fn):
+            continue
+        findings.append(Finding(
+            "COLL002", fi.rel, n.lineno, ctx,
+            "constant barrier id %s passed to %s from a function that "
+            "can run more than once per process — coordination-service "
+            "barrier ids are single-use within a service lifetime, and "
+            "a reused id lets a stale pending barrier pair with a newer "
+            "one (the PR 11 reuse bug); derive a sequence component "
+            "(\"...-%%d\" %% seq) into the name"
+            % (ast.dump(name_arg) if not isinstance(name_arg, ast.Constant)
+               else repr(name_arg.value), d or _tail(d))))
+
+
+def run(project):
+    findings = []
+    for fi in project.files:
+        _coll001(fi, findings)
+        _coll002(fi, findings)
+    return findings

@@ -1,0 +1,133 @@
+"""THR002 — no device collectives on side threads.
+
+A DEVICE collective (``dist.barrier`` / ``sync_global_devices`` /
+``dist.allreduce*`` — anything XLA executes over device slices) launched
+from a thread other than the main thread can interleave with the
+training collectives in flight on the main thread; collectives across a
+world must execute in one global order, so the interleaving deadlocks
+the whole fleet with no diagnosis.  This is the writer-thread deadlock
+``dist.coordination_barrier`` (coordination-service RPC, no device
+programs — exempt here) exists to avoid; before this rule it was
+guarded only by one hand-written runtime check inside
+``coordination_barrier`` itself.
+
+Thread-reachable = functions passed as ``threading.Thread`` /
+``threading.Timer`` ``target=`` (top-level, nested closures, and
+``self._method``), ``run`` methods of Thread subclasses, and functions
+submitted to a ``concurrent.futures`` executor (``pool.submit(f, ...)``)
+— propagated through same-file calls the way JIT001 propagates tracing.
+
+A deliberately-bounded probe (elastic ``health_check``'s
+generation-suffixed barrier) carries an inline suppression naming its
+protocol — and declares itself to the runtime twin with
+``sanitize.allow_thread_collective``.  mxsan's ``collective`` checker is
+this rule's dynamic half: a device dispatch noted off the main thread is
+a named runtime violation.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding
+
+RULE = "THR002"
+
+# device-collective dotted tails (coordination_barrier/wait_at_barrier
+# are service RPCs — thread-safe by design, NOT device collectives)
+DEVICE_COLLECTIVE_TAILS = {
+    "allreduce", "allreduce_arrays", "allreduce_tree", "barrier",
+    "sync_global_devices", "ppermute", "psum", "psum_scatter",
+    "all_gather", "all_to_all",
+}
+
+_THREAD_CTORS = ("threading.Thread", "threading.Timer", "Thread", "Timer")
+
+
+def _tail(dotted):
+    return dotted.rsplit(".", 1)[-1] if dotted else ""
+
+
+def _resolve_name(fi, name, at_node):
+    """Qualname candidates for a bare function name referenced at
+    ``at_node``: prefer a sibling nested def (closure targets), fall
+    back to any same-file def with that trailing name."""
+    funcs = fi.functions()
+    ctx = fi.context_of(at_node)
+    if ctx != "<module>" and (ctx + "." + name) in funcs:
+        return {ctx + "." + name}
+    return {q for q in funcs if q == name or q.endswith("." + name)}
+
+
+def _enclosing_class(fi, node):
+    for anc in fi.ancestors(node):
+        if isinstance(anc, ast.ClassDef):
+            return fi.qualnames.get(anc)
+    return None
+
+
+def _seeds(fi):
+    """Qualnames that run on a spawned thread."""
+    funcs = fi.functions()
+    seeds = set()
+    # Thread subclasses: their run() body
+    for cls_q, cls_node in fi.classes().items():
+        for base in cls_node.bases:
+            if fi.dotted(base) in ("threading.Thread", "Thread"):
+                if (cls_q + ".run") in funcs:
+                    seeds.add(cls_q + ".run")
+    for n in ast.walk(fi.tree):
+        if not isinstance(n, ast.Call):
+            continue
+        d = fi.dotted(n.func)
+        targets = []
+        if d in _THREAD_CTORS:
+            targets = [kw.value for kw in n.keywords
+                       if kw.arg in ("target", "function")]
+        elif _tail(d) == "submit" and n.args:
+            # executor.submit(fn, ...): the first argument runs on a
+            # pool thread
+            targets = [n.args[0]]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                seeds |= _resolve_name(fi, t.id, n)
+            elif isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                cls = _enclosing_class(fi, n)
+                if cls and (cls + "." + t.attr) in funcs:
+                    seeds.add(cls + "." + t.attr)
+    return seeds
+
+
+def run(project):
+    from . import rule_jit
+    findings = []
+    for fi in project.files:
+        funcs = fi.functions()
+        seeds = _seeds(fi)
+        if not seeds:
+            continue
+        reachable = rule_jit._propagate(fi, set(seeds))
+        for q in sorted(reachable):
+            node = funcs.get(q)
+            if node is None:
+                continue
+            own = {n for sub in ast.walk(node)
+                   if isinstance(sub, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))
+                   and sub is not node for n in ast.walk(sub)}
+            for n in ast.walk(node):
+                if n in own or not isinstance(n, ast.Call):
+                    continue
+                d = fi.dotted(n.func)
+                if _tail(d) in DEVICE_COLLECTIVE_TAILS:
+                    findings.append(Finding(
+                        RULE, fi.rel, n.lineno, q,
+                        "device collective %s is reachable from the "
+                        "thread body '%s' — an off-main-thread device "
+                        "collective can interleave with in-flight "
+                        "training collectives and deadlock the world; "
+                        "use dist.coordination_barrier (service RPC, "
+                        "thread-safe) or document the bounded protocol "
+                        "with a suppression" % (d or _tail(d), q)))
+    return findings
