@@ -16,10 +16,15 @@ This module replaces it:
   - ``stage<k>.params``       parameters + aux of pipeline stage ``k``
                               (single-program = everything in stage 0);
   - ``stage<k>-opt.params``   stage ``k``'s optimizer state (replicated mode);
-  - ``stage<k>-zero<j>.params``  row ``j`` of stage ``k``'s ZeRO-1 flat
-                              ``(dp, chunk)`` optimizer-state shards;
-  - ``manifest.json``         mesh/stage topology, the stage partition map,
-                              per-shard checksums, logical shapes, global
+  - ``stage<k>-zero<j>.params``  row ``j`` of stage ``k``'s ZeRO flat
+                              ``(dp, chunk)`` shards: optimizer state
+                              (``opt:`` entries, level >= 1) and, at
+                              ZeRO level 3, the parameters themselves
+                              (``argz:`` entries — logical shapes ride
+                              the manifest);
+  - ``manifest.json``         mesh/stage topology (incl. the ZeRO level),
+                              the stage partition map, per-shard
+                              checksums, logical shapes, global
                               step/epoch, format version — written LAST.
 
   Under a multi-process world the groups are distributed round-robin over
@@ -127,13 +132,26 @@ def snapshot(ts, params, opt_state, aux, *, step=None, epoch=0, nbatch=0,
     host_params, host_state, host_aux = jax.device_get(
         (params, opt_state if opt_state is not None else {}, aux))
     stage_of = topo["stage_of"]
+    # topo["zero"] is the ZeRO LEVEL (int; historical bools read as 0/1):
+    # level >= 1 shards optimizer state into (dp, chunk) rows, level 3
+    # additionally stores the parameters themselves as flat rows
+    # ("argz:" entries) — their logical shapes ride topo["param_shapes"]
+    zlevel = int(topo["zero"])
+    pshapes = topo.get("param_shapes") or {}
     groups = {}
 
     def grp(name):
         return groups.setdefault(name, {})
 
     for n, v in host_params.items():
-        grp("stage%d" % stage_of[n])["arg:%s" % n] = _np.asarray(v)
+        v = _np.asarray(v)
+        if zlevel >= 3:
+            # row j belongs to dp index j, like the optimizer-state rows
+            for j in range(v.shape[0]):
+                grp("stage%d-zero%d" % (stage_of[n], j))[
+                    "argz:%s" % n] = v[j]
+        else:
+            grp("stage%d" % stage_of[n])["arg:%s" % n] = v
     for n, v in host_aux.items():
         grp("stage%d" % stage_of[n])["aux:%s" % n] = _np.asarray(v)
     has_opt = opt_state is not None
@@ -142,7 +160,7 @@ def snapshot(ts, params, opt_state, aux, *, step=None, epoch=0, nbatch=0,
             s = stage_of[n]
             for i, leaf in enumerate(st):
                 leaf = _np.asarray(leaf)
-                if topo["zero"]:
+                if zlevel:
                     # (dp, chunk) flat shards: row j belongs to dp index j
                     for j in range(leaf.shape[0]):
                         grp("stage%d-zero%d" % (s, j))[
@@ -156,11 +174,14 @@ def snapshot(ts, params, opt_state, aux, *, step=None, epoch=0, nbatch=0,
         "epoch": int(epoch),
         "nbatch": int(nbatch),
         "topology": {"pp": int(topo["pp"]), "dp": int(topo["dp"]),
-                     "zero": bool(topo["zero"]),
+                     "zero": zlevel,
                      "microbatches": topo["microbatches"],
                      "world": _world()},
         "stage_of": {n: int(s) for n, s in stage_of.items()},
-        "params": {n: {"shape": list(_np.asarray(v).shape),
+        # manifest shapes are LOGICAL — for level-3 flat rows they come
+        # from the step's plan, and load_sharded unpads against them
+        "params": {n: {"shape": list(pshapes[n]) if zlevel >= 3
+                       else list(_np.asarray(v).shape),
                        "dtype": str(_np.asarray(v).dtype)}
                    for n, v in host_params.items()},
         "aux": {n: {"shape": list(_np.asarray(v).shape),
@@ -459,6 +480,7 @@ def load_sharded(path, verify=True):
     man = load_manifest(path)
     params, aux = {}, {}
     flat_leaves = {}                    # (name, i) -> leaf | {row: chunk}
+    zparams = {}                        # name -> {row: chunk} (ZeRO-3)
     for meta, entries in _iter_shards(path, man, verify=verify):
         m = _ZERO_RE.match(meta["group"])
         zrow = int(m.group(2)) if m else None
@@ -466,6 +488,9 @@ def load_sharded(path, verify=True):
             kind, rest = ename.split(":", 1)
             if kind == "arg":
                 params[rest] = arr
+            elif kind == "argz":
+                # ZeRO-3 flat parameter rows (row j = dp index j)
+                zparams.setdefault(rest, {})[zrow] = arr
             elif kind == "aux":
                 aux[rest] = arr
             elif kind == "opt":
@@ -475,6 +500,18 @@ def load_sharded(path, verify=True):
                     flat_leaves[key] = arr
                 else:
                     flat_leaves.setdefault(key, {})[zrow] = arr
+    for n, rows in zparams.items():
+        if sorted(rows) != list(range(len(rows))):
+            raise MXNetError(
+                "checkpoint %s: ZeRO-3 parameter rows of %s are not "
+                "contiguous (%s)" % (path, n, sorted(rows)))
+        shape = tuple(man["params"][n]["shape"])
+        size = 1
+        for d in shape:
+            size *= d
+        flat = _np.concatenate([rows[j].reshape(-1)
+                                for j in sorted(rows)])
+        params[n] = flat[:size].reshape(shape)
     if man["opt_state"] is None:
         return man, params, None, aux
     opt_state = {}

@@ -419,6 +419,7 @@ class Module(BaseModule):
         from .. import amp as _amp
         policy = _amp.resolve_policy(policy)
         pp_req = get_env("MXNET_PP", None, typ=int)
+        zero_req = get_env("MXNET_ZERO", None, typ=int)
 
         def fallback(why):
             # the general path is ~3.4x slower per batch (docs/perf.md);
@@ -433,6 +434,10 @@ class Module(BaseModule):
                 # single-program while the operator believes pp
                 why += " (MXNET_PP ignored: the general path is "\
                        "single-program)"
+            if zero_req:
+                # and for ZeRO: the general path trains fully replicated
+                why += " (MXNET_ZERO ignored: the general path "\
+                       "replicates params/grads/optimizer state)"
             logging.info("Module.fit: general (executor) path — %s", why)
             return None
 
@@ -440,7 +445,7 @@ class Module(BaseModule):
             return fallback("MXNET_FUSED_FIT=0")
         from .. import telemetry as _tel
         if _tel.enabled() and get_env("MXNET_TELEMETRY_FUSED", "0") != "1" \
-                and not (pp_req and pp_req > 1):
+                and not (pp_req and pp_req > 1) and not zero_req:
             # the fused step is ONE XLA program — it cannot be split into
             # forward/backward/update spans.  Telemetry implies the operator
             # wants the step-time breakdown, so run the general path; set
@@ -474,10 +479,10 @@ class Module(BaseModule):
             if isinstance(e, _san.SanitizerError):
                 raise   # a sanitizer contract violation in :raise mode is
                         # a finding, not a reason to fall back silently
-            if pp_req and pp_req > 1:
-                # the operator explicitly asked for pipeline stages — a
-                # mesh/partition error must halt, not silently train the
-                # whole model single-program
+            if (pp_req and pp_req > 1) or zero_req:
+                # the operator explicitly asked for pipeline stages or a
+                # ZeRO level — a mesh/level misconfiguration must halt,
+                # not silently train the whole model replicated
                 raise
             return fallback(str(e))
 
@@ -496,9 +501,13 @@ def _fused_fit_key_fields(opt, policy):
     MXNET_PP_INTERLEAVE, dispatch-time reads — docs/env_var.md "Pipeline
     parallelism") key the cache the same way: toggling them between fits
     swaps the TrainStep for a PipelineTrainStep (or back, or rebuilds it
-    under the newly-selected schedule) instead of reusing the stale step.  mxsan's RECOMPILE checker watches
-    this cache through these named fields — a seeded regression (step
-    state re-entering the key) is named field-by-field."""
+    under the newly-selected schedule) instead of reusing the stale step.
+    MXNET_ZERO (the ZeRO sharding level, read once here at dispatch)
+    rides the key identically — toggling levels between fits rebuilds
+    the step under the new placement plan; unset stays byte-identical to
+    the plain fused path (guard-tested).  mxsan's RECOMPILE checker
+    watches this cache through these named fields — a seeded regression
+    (step state re-entering the key) is named field-by-field."""
     from ..base import get_env, trace_env_key
     return {
         "optimizer": type(opt).__name__,
@@ -514,6 +523,7 @@ def _fused_fit_key_fields(opt, policy):
         "pp_microbatch": get_env("MXNET_PP_MICROBATCH", None, typ=int),
         "pp_schedule": get_env("MXNET_PP_SCHEDULE", None),
         "pp_interleave": get_env("MXNET_PP_INTERLEAVE", None, typ=int),
+        "zero": get_env("MXNET_ZERO", None, typ=int),
     }
 
 
@@ -535,6 +545,22 @@ class _FusedFit(object):
         key = tuple(sorted(fields.items()))
         pp = fields["pp"]
         self._pipeline = bool(pp and pp > 1)
+        # MXNET_ZERO=<level>: the ZeRO sharding ladder (docs/
+        # distributed.md "ZeRO levels"), read once at dispatch and
+        # carried in the cache key above
+        zero = int(fields["zero"] or 0)
+        if zero and not self._pipeline:
+            # checked on EVERY dispatch (not just a cache miss): a
+            # re-bound batch size must hit this curated error, never the
+            # jit's obscure uneven-sharding failure
+            n_dev = len(jax.devices())
+            bs = module._exec_group.batch_size
+            if bs % n_dev:
+                raise MXNetError(
+                    "MXNET_ZERO=%d shards each batch over all %d local "
+                    "device(s); batch size %d is not divisible — pick a "
+                    "divisible batch size (or compose with MXNET_PP to "
+                    "shrink the dp width)" % (zero, n_dev, bs))
         san = getattr(module, "_san_fused_cache", None)
         if san is None:
             san = module._san_fused_cache = _san.register_cache(
@@ -567,7 +593,22 @@ class _FusedFit(object):
                 num_microbatches=fields["pp_microbatch"],
                 schedule=fields["pp_schedule"],
                 interleave=fields["pp_interleave"],
+                zero=zero,
                 policy=policy)
+            module._fused_ts_cache = (key, self._ts)
+            san.miss(fields)
+        elif zero:
+            # MXNET_ZERO without MXNET_PP: one TrainStep over a dp mesh
+            # of ALL local devices, sharding per the requested level
+            # (optimizer state at 1, +gradients at 2, +parameters at 3)
+            from ..parallel.mesh import make_mesh
+            self._ts = TrainStep(module._symbol, opt,
+                                 data_names=tuple(module._data_names),
+                                 label_names=tuple(module._label_names),
+                                 mesh=make_mesh({"dp": len(jax.devices())},
+                                                devices=jax.devices()),
+                                 zero=zero,
+                                 policy=policy)
             module._fused_ts_cache = (key, self._ts)
             san.miss(fields)
         else:
@@ -585,29 +626,43 @@ class _FusedFit(object):
         self._ts._amp_emit = False
         dev = module._context[0].jax_device()
         self._dev = dev
+        # mesh-backed steps (pipeline stages / a ZeRO dp mesh): every
+        # buffer lives on the mesh, never one executor device — the
+        # sync-back path installs host-backed copies for both
+        self._mesh_mode = self._pipeline or \
+            getattr(self._ts, "mesh", None) is not None
         # loss-scale state follows the params onto the module's device
         # (pipeline: it lives on the final stage's sub-mesh instead)
         self._ts._scale_device = dev
         arg_params, aux_params = module.get_params()
         host_params = {n: arg_params[n].asnumpy()
                        for n in self._ts.param_names}
+        host_aux = {n: aux_params[n].asnumpy()
+                    for n in self._ts.aux_names}
         state = self._ts.fopt.init_state(host_params)
-        if self._pipeline:
+        # updater continuity merges host-side so every placement path
+        # below stages the finished state exactly once
+        self._merge_updater_state(state)
+        if getattr(self._ts, "zero", 0):
+            # any ZeRO level: optimizer state (and level-3 parameters)
+            # live sharded — place through the same level-aware path the
+            # checkpoint restore uses (the placement plan re-chunks)
+            self._params, self._state, self._aux = \
+                self._ts.place_checkpoint(host_params, state, host_aux,
+                                          device=None)
+        elif self._pipeline:
             # every pytree lands on its stage's sub-mesh slice — the
             # per-device parameter footprint drops ~1/pp vs replicated
             self._params = self._ts.place_params(host_params)
             self._state = self._ts.place_state(state)
-            self._import_updater_state()
-            self._aux = self._ts.place_aux(
-                {n: aux_params[n].asnumpy() for n in self._ts.aux_names})
+            self._aux = self._ts.place_aux(host_aux)
         else:
             self._params = {n: jax.device_put(v, dev)
                             for n, v in host_params.items()}
             self._state = {n: tuple(jax.device_put(s, dev) for s in st)
                            for n, st in state.items()}
-            self._import_updater_state()
-            self._aux = {n: jax.device_put(aux_params[n].asnumpy(), dev)
-                         for n in self._ts.aux_names}
+            self._aux = {n: jax.device_put(v, dev)
+                         for n, v in host_aux.items()}
         names = module._data_names + module._label_names
         self._input_names = names
         resume = getattr(module, "_ckpt_resume", None)
@@ -666,28 +721,26 @@ class _FusedFit(object):
             u = getattr(mod._kvstore, "_updater", None)
         return u
 
-    def _import_updater_state(self):
+    def _merge_updater_state(self, state):
         """Seed the fused optimizer state from the Updater's accumulated
-        states (a second fit() on the same module must continue momentum /
-        Adam moments exactly like the reference's persistent updater does;
-        sync_back exports in the same layout)."""
-        import jax
+        states — host-side, BEFORE placement, so one placement path
+        stages the finished state for every plan (replicated, pipeline
+        stages, ZeRO shards).  A second fit() on the same module must
+        continue momentum / Adam moments exactly like the reference's
+        persistent updater does; sync_back exports in the same layout.
+        Mutates the LOGICAL host ``state`` in place."""
         updater = self._updater()
         if updater is None or not updater.states:
             return
-        kind = self._ts.fopt.kind
         for idx, name in enumerate(self._ts.param_names):
             st = updater.states.get(idx)
             if st is None:
                 continue
             vals = st if isinstance(st, tuple) else (st,)
             vals = tuple(v for v in vals if v is not None)
-            if len(vals) != len(self._state[name]):
+            if len(vals) != len(state[name]):
                 continue  # layout mismatch (e.g. dcasgd's (mom, prev_w))
-            dst = self._ts.param_sharding(name) if self._pipeline \
-                else self._dev
-            self._state[name] = tuple(
-                jax.device_put(v.asnumpy(), dst) for v in vals)
+            state[name] = tuple(v.asnumpy() for v in vals)
         # continue the update count (Adam bias correction, lr schedules)
         counts = getattr(self._mod._optimizer, "_index_update_count", None)
         if counts:
@@ -726,10 +779,11 @@ class _FusedFit(object):
         from ..parallel import mesh as _mesh
         depth = _io.device_prefetch_depth()
         if depth == 0 or _mesh.sequence_mesh()[0] is not None \
-                or self._pipeline:
+                or self._mesh_mode:
             # pipeline: the step splits each batch into microbatches and
-            # stages every slice onto its consuming stage's sub-mesh —
-            # single-device whole-batch staging would fight that placement
+            # stages every slice onto its consuming stage's sub-mesh; a
+            # ZeRO dp mesh shards each batch over dp at dispatch —
+            # single-device whole-batch staging would fight both
             return data_iter
         return _io.DevicePrefetchIter(data_iter, stage=self._stage,
                                       depth=depth)
@@ -757,8 +811,14 @@ class _FusedFit(object):
         self._mod._active_fused = self
         # labels staged onto the step's device so the metric's same-device
         # lazy reduction engages (pipeline: the outputs live on the final
-        # stage's sub-mesh)
-        dst = self._ts.output_sharding() if self._pipeline else self._dev
+        # stage's sub-mesh; a ZeRO dp mesh: dp-sharded like the batch)
+        if self._pipeline:
+            dst = self._ts.output_sharding()
+        elif getattr(self._ts, "mesh", None) is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            dst = NamedSharding(self._ts.mesh, PartitionSpec("dp"))
+        else:
+            dst = self._dev
         labels = [nd.NDArray(jax.device_put(batch[n], dst))
                   for n in self._mod._label_names if n in batch]
         return [nd.NDArray(o) for o in outs], labels
@@ -775,21 +835,29 @@ class _FusedFit(object):
         # COPIES, not aliases: the next fused step donates self._params/
         # _state/_aux to XLA — anything installed in the executors, kvstore
         # or updater must own its buffer or it dies with the donation.
-        # (The pipeline path installs host-backed arrays instead, so the
-        # device copies would be dead weight there.)
+        # (Mesh-backed paths — pipeline stages, a ZeRO dp mesh — install
+        # host-backed arrays instead, so the device copies would be dead
+        # weight there.)
         params_cp = aux_cp = None
-        if not self._pipeline:
+        if not self._mesh_mode:
             params_cp = {n: jnp.copy(v) for n, v in self._params.items()}
             aux_cp = {n: jnp.copy(v) for n, v in self._aux.items()}
         host_params = host_aux = None
-        if mod._arg_params is not None or self._pipeline:
+        zero3 = getattr(self._ts, "zero", 0) >= 3
+        export_params = self._params
+        if zero3 and not self._pipeline:
+            # ZeRO-3: materialise logical replicated params with the one
+            # registered all-gather program (zero.gather) before the
+            # batched fetch — the flat shards never leave the mesh
+            export_params = self._ts.gather_params(self._params)
+        if mod._arg_params is not None or self._mesh_mode:
             # Batched device->host transfer: concatenate on device, split on
             # host (jax.device_get fetches leaf by leaf — a round trip each on
             # a tunneled TPU). One concat PER (DTYPE, DEVICE GROUP): casting
             # everything through f32 would silently truncate f64 or integer
             # params/aux, and pipeline-stage arrays living on different
             # sub-meshes cannot meet in one concatenation.
-            items = [("arg", n, v) for n, v in sorted(self._params.items())] \
+            items = [("arg", n, v) for n, v in sorted(export_params.items())] \
                 + [("aux", n, v) for n, v in sorted(self._aux.items())]
             by_group = {}
             for it in items:
@@ -810,10 +878,15 @@ class _FusedFit(object):
                     chunk = flat[ofs:ofs + size].reshape(v.shape)
                     ofs += size
                     (host_params if kind == "arg" else host_aux)[n] = chunk
-        if self._pipeline:
-            # per-stage sub-mesh arrays must not reach the executors (one
-            # later score()/forward() program cannot span the stages) —
-            # install host-backed copies instead
+            if zero3 and self._pipeline:
+                # pipeline ZeRO-3 fetches the flat (dp, chunk) stage
+                # shards — unpad to logical shapes on the host
+                host_params = {n: self._ts.unflatten_host(n, v)
+                               for n, v in host_params.items()}
+        if self._mesh_mode:
+            # mesh arrays (stage sub-meshes / the ZeRO dp mesh) must not
+            # reach the executors (one later score()/forward() program
+            # cannot span them) — install host-backed copies instead
             arg = {n: nd.array(v) for n, v in host_params.items()}
             aux = {n: nd.array(v) for n, v in host_aux.items()}
         else:
@@ -851,11 +924,22 @@ class _FusedFit(object):
             return
         # optimizer-state copies only when someone will hold them (the
         # donation-alias hazard applies to these too)
-        state_cp = {n: tuple(jnp.copy(s) for s in st)
-                    for n, st in self._state.items()}
+        if getattr(self._ts, "zero", 0):
+            # ZeRO state lives as flat (dp, chunk) mesh shards — export
+            # the LOGICAL host view so save_optimizer_states (and a
+            # later non-ZeRO fit) keeps the reference layout
+            st_host = jax.device_get(self._state)
+            state_cp = {n: tuple(self._ts.unflatten_host(n, s)
+                                 for s in st)
+                        for n, st in st_host.items()}
+            _wrap = nd.array
+        else:
+            state_cp = {n: tuple(jnp.copy(s) for s in st)
+                        for n, st in self._state.items()}
+            _wrap = nd.NDArray
         kind = self._ts.fopt.kind
         for idx, name in enumerate(self._ts.param_names):
-            st = tuple(nd.NDArray(s) for s in state_cp[name])
+            st = tuple(_wrap(s) for s in state_cp[name])
             # mirror each Optimizer.create_state layout (optimizer.py)
             if kind in ("sgd", "ccsgd", "nag"):
                 updater.states[idx] = st[0] if st else None

@@ -7,10 +7,14 @@
   tracker replacement built on jax.distributed + XLA collectives over ICI/DCN
 - ``elastic``: failure detection + checkpoint-resume recovery (the ps-lite
   heartbeat/is_recovery machinery, SURVEY.md §5.3, rebuilt TPU-native)
+- ``placement``: parameter-placement plans (ZeRO levels 0-3: optimizer/
+  gradient/parameter sharding over dp as one explicit, schedule-orthogonal
+  knob — docs/distributed.md "ZeRO levels")
 - ``ring``: ring attention / sequence-context parallelism (new capability;
   the reference has none — SURVEY.md §5.7)
 """
 from . import dist
 from . import mesh
+from . import placement
 from . import schedule
 from . import elastic

@@ -1,0 +1,308 @@
+"""Parameter-placement plans — ZeRO levels 0-3 behind one explicit object.
+
+TrainStep, PipelineTrainStep and the checkpoint restore path used to share
+their placement-and-update logic informally (``_host_init``,
+``_flat_shards``, ``place_params``/``place_state``, ``_zero_state_host``
+— the ROADMAP item 2 refactor target).  :class:`PlacementPlan` makes the
+contract explicit so the pipeline schedule (gpipe/1f1b/interleaved) and
+the sharding level are orthogonal knobs:
+
+=====  ======================  =============================  ==================
+level  parameters              gradients                      optimizer state
+=====  ======================  =============================  ==================
+0      replicated              full tree, all-reduced         replicated
+1      replicated              full tree; flat ``(dp,chunk)``  flat ``(dp,chunk)``
+       .                       views inside the update         dp-sharded
+2      replicated              ONE flat ``(dp,chunk)`` bucket  flat ``(dp,chunk)``
+       .                       (reduce-scatter residency; the  dp-sharded
+       .                       full tree never persists), one
+       .                       all-gather of *updated params*
+3      flat ``(dp,chunk)``     bucket, as level 2 — but the    flat ``(dp,chunk)``
+       dp-sharded; gathered    updated shards stay sharded     dp-sharded
+       just-in-time in the     (no gather at all)
+       step, freed after use
+=====  ======================  =============================  ==================
+
+Per-device model footprint at level 3 scales ~``1/(pp * dp)`` when
+composed with pipeline stages — the memory lever that opens models past
+one chip's HBM (docs/distributed.md "ZeRO levels").
+
+The flat ``(dp, chunk)`` layout (zero-padded, device ``i`` owns row
+``i``) is THE wire contract shared by the in-step math, host placement,
+and the sharded checkpoint writer — it exists exactly once, here.
+Elementwise optimizer math commutes with the view, so every level trains
+to exact parity with the replicated step (f64 @1e-9, test-pinned).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["PlacementPlan", "normalize_zero", "chunk_rows", "flat_shards",
+           "from_flat", "flat_np"]
+
+
+# ------------------------------------------------------- flat (dp, chunk)
+# The layout primitives live at module level so TrainStep /
+# PipelineTrainStep / checkpoint all consume literally the same code.
+
+def chunk_rows(size, dp):
+    """Row width of the flat (dp, chunk) view for ``size`` elements —
+    THE layout contract between :func:`flat_shards` and everything that
+    slices its output (bucket offsets, the ZeRO update's per-param
+    views, the checkpoint row writer): exactly one place."""
+    return -(-int(size) // int(dp))
+
+
+def flat_shards(x, dp):
+    """Logical tensor -> flat (dp, chunk) view, zero-padded; device ``i``
+    owns row ``i`` (traced).  Elementwise optimizer math commutes with
+    this view.  An already-flat (dp, chunk) input round-trips
+    unchanged."""
+    import jax.numpy as jnp
+    size = _size_of(x.shape)
+    chunk = chunk_rows(size, dp)
+    flat = jnp.reshape(x, (-1,))
+    pad = dp * chunk - size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return jnp.reshape(flat, (dp, chunk))
+
+
+def from_flat(xf, shape):
+    """Flat (dp, chunk) view -> logical tensor (traced)."""
+    import jax.numpy as jnp
+    return jnp.reshape(jnp.reshape(xf, (-1,))[:_size_of(shape)], shape)
+
+
+def flat_np(v, dp):
+    """Host-side flat (dp, chunk) view — THE save/restore wire contract
+    for ZeRO optimizer state and level-3 parameters (the checkpoint
+    writer slices its rows and ``load_sharded`` unpads by
+    ``flat[:size]``)."""
+    v = _np.asarray(v)
+    chunk = chunk_rows(v.size, dp)
+    out = _np.zeros((dp, chunk), v.dtype)
+    out.reshape(-1)[:v.size] = v.reshape(-1)
+    return out
+
+
+def normalize_zero(zero):
+    """ZeRO level from the public ``zero=`` argument: ``False``/``True``
+    keep their historical meaning (off / level 1), integers pass through.
+    Levels outside 0..3 are a loud misconfiguration."""
+    if isinstance(zero, bool):
+        return 1 if zero else 0
+    level = int(zero)
+    if not 0 <= level <= 3:
+        raise MXNetError(
+            "zero=%r: ZeRO level must be 0 (off), 1 (optimizer-state "
+            "sharding), 2 (+gradient sharding) or 3 (+parameter sharding)"
+            % (zero,))
+    return level
+
+
+def _size_of(shape):
+    size = 1
+    for d in shape:
+        size *= d
+    return size
+
+
+def _pspec(*names):
+    from jax.sharding import PartitionSpec
+    return PartitionSpec(*names)
+
+
+class PlacementPlan(object):
+    """One step's parameter-placement plan: ZeRO level + dp width + the
+    flat-shard layout helpers and the sharded update math.
+
+    The traced helpers take the target Mesh per call — the whole mesh
+    for ``TrainStep``, the owning stage's sub-mesh for
+    ``PipelineTrainStep`` (sharding level composes with any schedule).
+    The plan captures each parameter's LOGICAL shape at placement time
+    (``note_host``); level 3 needs them to rebuild full tensors from
+    the flat shards (``shape_of`` / ``unflatten_host``)."""
+
+    def __init__(self, zero=0, dp=1, who="TrainStep"):
+        self.zero = normalize_zero(zero)
+        self.dp = int(dp) if self.zero else 1
+        self._who = who
+        self._shapes = {}
+
+    # ------------------------------------------------------------- properties
+    @property
+    def shard_state(self):
+        """Optimizer state lives as flat (dp, chunk) shards (level >= 1)."""
+        return self.zero >= 1
+
+    @property
+    def bucket_grads(self):
+        """Gradient residency is the flat (dp, chunk) bucket (level >= 2)."""
+        return self.zero >= 2
+
+    @property
+    def shard_params(self):
+        """Parameters live sharded; gather just-in-time (level >= 3)."""
+        return self.zero >= 3
+
+    # ----------------------------------------------------------- flat layout
+    def chunk_rows(self, size):
+        return chunk_rows(size, self.dp)
+
+    def flat_shards(self, x):
+        return flat_shards(x, self.dp)
+
+    def from_flat(self, xf, shape):
+        return from_flat(xf, shape)
+
+    # --------------------------------------------------------- shape registry
+    def note_host(self, host_arrays):
+        """Capture logical shapes from host tensors (placement time) —
+        level 3's flat device buffers no longer carry them."""
+        for n, v in host_arrays.items():
+            self._shapes[n] = tuple(int(d)
+                                    for d in _np.asarray(v).shape)
+
+    def shape_of(self, name):
+        if name not in self._shapes:
+            raise MXNetError(
+                "%s: logical shape of %s unknown — call init() or "
+                "place_checkpoint() before stepping (ZeRO-3 buffers are "
+                "flat shards; the plan records logical shapes at "
+                "placement via note_host)" % (self._who, name))
+        return self._shapes[name]
+
+    def unflatten_host(self, name, arr):
+        """Host flat (dp, chunk) array -> logical tensor (checkpoint /
+        sync-back export)."""
+        shape = self.shape_of(name)
+        arr = _np.asarray(arr)
+        return arr.reshape(-1)[:_size_of(shape)].reshape(shape)
+
+    # -------------------------------------------------------------- placement
+    def param_spec(self, name, custom=None):
+        """PartitionSpec of a parameter's resident buffer: flat
+        dp-sharded at level 3, else the caller's custom spec/replicated."""
+        if self.shard_params:
+            return _pspec("dp")
+        return custom if custom is not None else _pspec()
+
+    # ------------------------------------------------------- traced step math
+    def gather_params(self, params, mesh):
+        """Flat shards -> logical, replicated parameters (traced; the
+        just-in-time all-gather of the ZeRO-3 forward).  XLA frees the
+        gathered tensors when their last use retires — full weights are
+        a transient of the step, never a residency."""
+        import jax
+        from jax.sharding import NamedSharding
+        if not self.shard_params:
+            return params
+        rep = NamedSharding(mesh, _pspec())
+        return {n: jax.lax.with_sharding_constraint(
+            self.from_flat(v, self.shape_of(n)), rep)
+            for n, v in params.items()}
+
+    def bucket_layout(self, params, names=None):
+        """Static (name, chunk_rows) layout of the flat gradient bucket
+        — per-param (dp, chunk) views concatenated along the chunk axis,
+        so row ``d`` holds device ``d``'s shard of every parameter
+        contiguously.  Works on logical OR flat param leaves (a flat
+        (dp, chunk) leaf re-chunks to the same width)."""
+        names = list(names if names is not None else params)
+        return [(n, self.chunk_rows(_size_of(params[n].shape)))
+                for n in names]
+
+    def fold_bucket(self, grads, params, layout, mesh):
+        """Fold a full gradient tree into ONE flat (dp, chunk) bucket
+        with a dp-sharded constraint — the reduction lowers as a
+        reduce-scatter and the bucket is the only gradient residency
+        (level >= 2).  Returns None for an empty layout."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        if not layout:
+            return None
+        flat = jnp.concatenate(
+            [self.flat_shards(grads[n].astype(params[n].dtype))
+             for n, _ in layout], axis=1)
+        return jax.lax.with_sharding_constraint(
+            flat, NamedSharding(mesh, _pspec("dp")))
+
+    def shard_update(self, fopt, params, bucket, layout, opt_state, hyper,
+                     t, rng, mesh):
+        """The sharded optimizer step over a gradient bucket (level >= 2):
+        each rank updates its (dp, chunk) rows; level 2 re-materialises
+        replicated parameters with ONE all-gather of the concatenated
+        updated rows (replacing the gradient gather), level 3 keeps the
+        updated shards sharded — no gather at all."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        if not layout:
+            return {}, {}
+        sh_dp = NamedSharding(mesh, _pspec("dp"))
+        rep = NamedSharding(mesh, _pspec())
+        new_state = {}
+        new_rows = []
+        off = 0
+        for n, c in layout:
+            w = params[n]
+            gf = bucket[:, off:off + c].astype(w.dtype)
+            off += c
+            if self.shard_params:
+                wf = jax.lax.with_sharding_constraint(w, sh_dp)
+            else:
+                wf = jax.lax.with_sharding_constraint(
+                    self.flat_shards(w), sh_dp)
+            nwf, new_state[n] = fopt.update(n, wf, gf, opt_state[n],
+                                            hyper, t, rng=rng)
+            new_rows.append(nwf)
+        new_params = {}
+        if self.shard_params:
+            for (n, _c), nwf in zip(layout, new_rows):
+                new_params[n] = jax.lax.with_sharding_constraint(nwf,
+                                                                 sh_dp)
+            return new_params, new_state
+        # level 2: one gather of the UPDATED parameters for the whole
+        # bucket (the scatter half already happened inside fold_bucket's
+        # constraint), then slice back to logical shapes
+        gathered = jax.lax.with_sharding_constraint(
+            jnp.concatenate(new_rows, axis=1), rep)
+        off = 0
+        for n, c in layout:
+            new_params[n] = self.from_flat(
+                gathered[:, off:off + c],
+                params[n].shape).astype(params[n].dtype)
+            off += c
+        return new_params, new_state
+
+    # -------------------------------------------------------- byte accounting
+    def per_device_bytes(self, params, opt_state=None):
+        """Per-device {param, grad, opt} byte residency from shape
+        metadata only (no syncs) — the ``zero_param_bytes`` /
+        ``zero_grad_bytes`` gauge source and the dryrun ladder's memory
+        stamp.  Gradient residency: the bucket's one row per device at
+        level >= 2, the full tree below."""
+        from .. import telemetry as _tel
+        nb = _tel.nbytes_of
+        param = grad = opt = 0
+        for n, v in params.items():
+            b = nb(v)
+            param += b // self.dp if self.shard_params else b
+            if self.bucket_grads:
+                size = _size_of(self.shape_of(n) if self.shard_params
+                                else v.shape)
+                grad += self.chunk_rows(size) * _np.dtype(v.dtype).itemsize
+            else:
+                # tree residency (levels 0-1; shard_params implies
+                # bucket_grads, so this is always the full tree)
+                grad += b
+        if opt_state:
+            for st in opt_state.values():
+                for leaf in st:
+                    b = nb(leaf)
+                    opt += b // self.dp if self.shard_state else b
+        return {"param": int(param), "grad": int(grad), "opt": int(opt)}

@@ -30,6 +30,8 @@ from .base import MXNetError, trace_env_key
 from . import ndarray as nd
 from . import random as _random
 from . import sanitize as _san
+from .parallel.placement import PlacementPlan, normalize_zero
+from .parallel import placement as _placement
 
 __all__ = ["TrainStep", "EvalStep", "PipelineTrainStep",
            "pipeline_bubble_fraction"]
@@ -53,36 +55,12 @@ def _pspec(*names):
     return PartitionSpec(*names)
 
 
-def _chunk_rows(size, dp):
-    """Row width of the flat (dp, chunk) shard view for a tensor of
-    ``size`` elements — THE layout contract between ``_flat_shards`` and
-    everything that slices its output (the pipeline gradient bucket's
-    offsets, the ZeRO update's per-param views): exactly one place."""
-    return -(-size // dp)
-
-
-def _flat_shards(x, dp):
-    """Logical tensor -> flat (dp, chunk) view, zero-padded; device i owns
-    row i.  Elementwise optimizer math commutes with this view (the ZeRO-1
-    shard layout, shared by TrainStep and PipelineTrainStep)."""
-    import jax.numpy as jnp
-    size = 1
-    for d in x.shape:
-        size *= d
-    chunk = _chunk_rows(size, dp)
-    flat = jnp.reshape(x, (-1,))
-    pad = dp * chunk - size
-    if pad:
-        flat = jnp.pad(flat, (0, pad))
-    return jnp.reshape(flat, (dp, chunk))
-
-
-def _from_flat_shards(xf, shape):
-    import jax.numpy as jnp
-    size = 1
-    for d in shape:
-        size *= d
-    return jnp.reshape(jnp.reshape(xf, (-1,))[:size], shape)
+# flat (dp, chunk) layout: one implementation, in the placement plan
+# module (parallel/placement.py) — these aliases keep the historical
+# train-module names every existing caller uses
+_chunk_rows = _placement.chunk_rows
+_flat_shards = _placement.flat_shards
+_from_flat_shards = _placement.from_flat
 
 
 def _host_init(symbol, low, param_names, aux_names, data_shapes,
@@ -119,23 +97,13 @@ def _host_init(symbol, low, param_names, aux_names, data_shapes,
     return params, aux
 
 
-def _flat_np(v, dp):
-    """Host-side ZeRO flat view: pad ``v`` and reshape to ``(dp, chunk)``
-    with ``chunk = ceil(size / dp)``.  THE save/restore wire contract for
-    ZeRO optimizer state — the checkpoint writer slices its rows and
-    ``load_sharded`` unpads by ``flat[:size]`` — so it exists exactly
-    once (state init and both ``place_checkpoint``s share it)."""
-    v = _np.asarray(v)
-    chunk = _chunk_rows(v.size, dp)
-    out = _np.zeros((dp, chunk), v.dtype)
-    out.reshape(-1)[:v.size] = v.reshape(-1)
-    return out
+_flat_np = _placement.flat_np
 
 
 def _zero_state_host(fopt, params, dp):
-    """ZeRO-1 optimizer state born as flat (dp, chunk) host templates —
+    """ZeRO optimizer state born as flat (dp, chunk) host templates —
     padded param values, so dcasgd's prev-weight state starts AT the
-    weight exactly as in replicated mode."""
+    weight exactly as in replicated mode (any level >= 1)."""
     return fopt.init_state({n: _flat_np(v, dp) for n, v in params.items()})
 
 
@@ -413,26 +381,46 @@ class TrainStep(object):
         # MXNET_CHECK_NUMERICS hook; Module.fit's fused driver flips this
         # off because the fit loop re-checks with epoch/nbatch context
         self.check_numerics = True
-        # ZeRO-1 (opt-in): shard the optimizer step over dp — gradients
-        # reach the update as reduce-scattered 1/dp shards, optimizer state
-        # lives permanently sharded, and only the updated parameters are
-        # all-gathered back to replicated.  Collective bytes per step drop
-        # from 2x params (all-reduce) to 1x (scatter + gather halves), and
-        # optimizer-state HBM drops by dp.  The reference's PS design
-        # (src/kvstore/kvstore_dist.h:28-318) has no analogue — its servers
-        # hold whole key ranges; this is the TPU-native ICI shape of the
-        # same aggregation.
-        self.zero = bool(zero)
+        # ZeRO levels (opt-in; docs/distributed.md "ZeRO levels"): the
+        # dp-axis sharding ladder as one explicit placement plan.  Level 1
+        # shards the optimizer step (gradients reach the update as
+        # reduce-scattered 1/dp shards, state lives permanently sharded,
+        # updated params all-gather back).  Level 2 makes the flat
+        # (dp, chunk) bucket the ONLY gradient residency (the full tree
+        # folds into it straight off the vjp) and replaces the gradient
+        # gather with one all-gather of *updated* parameters.  Level 3
+        # additionally shards the parameters themselves — full weights
+        # are gathered just-in-time inside the step and freed after use,
+        # so per-device model footprint scales ~1/dp (1/(pp*dp) composed
+        # with pipeline stages).  The reference's PS design
+        # (src/kvstore/kvstore_dist.h:28-318) has no analogue — its
+        # servers hold whole key ranges; this is the TPU-native ICI shape
+        # of the same aggregation.  ``zero=True`` keeps its historical
+        # level-1 meaning.
+        self.zero = normalize_zero(zero)
         if self.zero:
             if mesh is None or "dp" not in mesh.axis_names:
                 raise MXNetError(
-                    "TrainStep(zero=True) needs a mesh with a 'dp' axis")
+                    "TrainStep(zero=%d) needs a mesh with a 'dp' axis"
+                    % self.zero)
             if any(n in self.param_shardings for n in self.param_names):
                 raise MXNetError(
-                    "TrainStep(zero=True) shards the optimizer over dp; "
+                    "TrainStep(zero=%d) shards the optimizer over dp; "
                     "combine it with tensor-parallel param_shardings is "
-                    "not supported yet")
+                    "not supported yet" % self.zero)
         self._dp = int(mesh.shape["dp"]) if self.zero else 1
+        self.plan = PlacementPlan(zero=self.zero, dp=self._dp,
+                                  who="TrainStep")
+        self._zb_cache = None   # zero_*_bytes gauge memo (step-invariant)
+        self._gather_fn = None
+        if self.zero >= 3:
+            # the params all-gather program (gather_params): registered
+            # like every jit cache (CKEY001 CACHES row; the program reads
+            # no env levers — pure reshape + sharding constraint)
+            self._san_gather = _san.register_cache(
+                "zero.gather", kind="zero_gather", owner=self,
+                sizer=lambda ts: 1 if ts._gather_fn is not None else 0,
+                warmup=1, jit_names=("mxtpu_zero_gather",))
         low = self._low
 
         def fwd(params, aux, batch, rng, head_scale=None):
@@ -491,15 +479,45 @@ class TrainStep(object):
                 new_params[n] = jax.lax.with_sharding_constraint(nw, rep)
             return new_params, new_state
 
+        plan = self.plan
+
+        def bucket_update(params, grads, opt_state, hyper, t, rng):
+            """ZeRO-2/3 update: ``grads`` is the (layout, bucket) pair —
+            the folded flat (dp, chunk) residency — and the plan's
+            sharded update consumes the rows (level 2 re-materialises
+            replicated params with ONE all-gather of the updated rows;
+            level 3 keeps them sharded)."""
+            layout, bucket = grads
+            return plan.shard_update(self.fopt, params, bucket, layout,
+                                     opt_state, hyper, t, rng, mesh)
+
+        def fold_grads(params, gtree):
+            """Gradient residency per the plan: level >= 2 folds the vjp
+            tree into ONE dp-sharded bucket immediately (each per-param
+            view lowers its reduction as a reduce-scatter; the full tree
+            never persists past this fold), below it the tree IS the
+            residency."""
+            if not plan.bucket_grads:
+                return gtree
+            layout = plan.bucket_layout(params, self.param_names)
+            return (layout, plan.fold_bucket(gtree, params, layout, mesh))
+
         def step(params, opt_state, aux, batch, rng, hyper, t):
             import jax.numpy as jnp
+            # ZeRO-3: gather the flat parameter shards to full tensors
+            # just-in-time (identity below level 3); XLA frees the
+            # gathered weights when their last use retires
+            fullp = plan.gather_params(params, mesh)
 
             def f(p):
                 return fwd(p, aux, batch, rng)
-            outs, vjp_fn, aux_upd = jax.vjp(f, params, has_aux=True)
+            outs, vjp_fn, aux_upd = jax.vjp(f, fullp, has_aux=True)
             ones = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
-            grads = vjp_fn(ones)[0]
-            upd = update_zero if self.zero else update_all
+            grads = fold_grads(params, vjp_fn(ones)[0])
+            if plan.bucket_grads:
+                upd = bucket_update
+            else:
+                upd = update_zero if self.zero else update_all
             new_params, new_state = upd(params, grads, opt_state, hyper, t,
                                         rng)
             new_aux = dict(aux)
@@ -513,27 +531,43 @@ class TrainStep(object):
             import jax.numpy as jnp
 
             scale = lsc["scale"]
+            fullp = plan.gather_params(params, mesh)
 
             def f(p):
                 # the scale is injected at the loss heads (executor's
                 # scale-backward identity): the heads ignore incoming
                 # cotangents, so seeding would not reach the chain
                 return fwd(p, aux, batch, rng, scale)
-            outs, vjp_fn, aux_upd = jax.vjp(f, params, has_aux=True)
+            outs, vjp_fn, aux_upd = jax.vjp(f, fullp, has_aux=True)
             ones = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
-            grads = vjp_fn(ones)[0]
-            # overflow detection on the SCALED f32 grads, on device
-            finite = jnp.stack(
-                [jnp.isfinite(g).all()
-                 for g in jax.tree_util.tree_leaves(grads)]).all()
+            gtree = vjp_fn(ones)[0]
+            grads = fold_grads(params, gtree)
+            if plan.bucket_grads:
+                # overflow detection on the bucket — the only gradient
+                # residency (an inf/nan survives the reduce-scatter sum)
+                _layout, bucket = grads
+                finite = jnp.isfinite(bucket).all() \
+                    if bucket is not None else jnp.bool_(True)
+                upd = bucket_update
+            else:
+                # overflow detection on the SCALED f32 grads, on device
+                finite = jnp.stack(
+                    [jnp.isfinite(g).all()
+                     for g in jax.tree_util.tree_leaves(gtree)]).all()
+                upd = update_zero if self.zero else update_all
             inv = jnp.float32(1.0) / scale
-            upd = update_zero if self.zero else update_all
 
             def do_update(_):
                 # unscale by 1/S exactly once; the optimizer's own
                 # rescale_grad (1/batch) applies inside the rule as always
-                grads_u = {n: g * inv.astype(g.dtype)
-                           for n, g in grads.items()}
+                if plan.bucket_grads:
+                    layout, bucket = grads
+                    grads_u = (layout,
+                               bucket * inv.astype(bucket.dtype)
+                               if bucket is not None else None)
+                else:
+                    grads_u = {n: g * inv.astype(g.dtype)
+                               for n, g in grads.items()}
                 new_params, new_state = upd(params, grads_u, opt_state,
                                             hyper, t, rng)
                 new_aux = dict(aux)
@@ -544,7 +578,8 @@ class TrainStep(object):
             def skip_update(_):
                 # overflow step: weights, optimizer state AND the BN
                 # moving stats all stay put (inf activations must not
-                # poison running statistics)
+                # poison running statistics; ZeRO-3 master shards are
+                # returned untouched — test-pinned)
                 return params, opt_state, dict(aux)
 
             new_params, new_state, new_aux = jax.lax.cond(
@@ -581,6 +616,11 @@ class TrainStep(object):
             def par_shard(n):
                 return NamedSharding(mesh, ps[n]) if n in ps else rep
             param_sh = {n: par_shard(n) for n in self.param_names}
+            if self.zero >= 3:
+                # ZeRO-3: the resident parameter buffers ARE the flat
+                # (dp, chunk) shards — dp-sharded in, dp-sharded out
+                sh_dp3 = NamedSharding(mesh, _pspec("dp"))
+                param_sh = {n: sh_dp3 for n in self.param_names}
             batch_sh = {n: NamedSharding(mesh, _pspec("dp"))
                         for n in inputs}
             state_sh = NamedSharding(mesh, _pspec("dp")) if self.zero \
@@ -616,7 +656,7 @@ class TrainStep(object):
             self._step = jax.jit(step, donate_argnums=(0, 1, 2),
                                  compiler_options=_xla_options())
 
-    # ---------------------------------------------------------- ZeRO-1 views
+    # ---------------------------------------------------------- ZeRO views
     def _chunk(self, size):
         return _chunk_rows(size, self._dp)
 
@@ -626,20 +666,74 @@ class TrainStep(object):
     def _from_shards(self, xf, shape):
         return _from_flat_shards(xf, shape)
 
+    def unflatten_host(self, name, arr):
+        """Host flat (dp, chunk) array -> the logical tensor (the
+        sync-back/export half of the ZeRO-3 layout)."""
+        return self.plan.unflatten_host(name, arr)
+
+    def gather_params(self, params):
+        """Materialise logical, REPLICATED parameters from the ZeRO-3
+        flat shards: one jitted all-gather program (the registered
+        ``zero.gather`` cache; ``zero.gather`` telemetry span; a
+        collective-ledger entry under mxsan).  Identity below level 3 —
+        callers that need full weights (sync-back, eval hand-off) use
+        this unconditionally."""
+        if self.zero < 3:
+            return params
+        import jax
+        from . import telemetry as _tel
+        if self._gather_fn is None:
+            plan, mesh = self.plan, self.mesh
+            from jax.sharding import NamedSharding
+            rep = NamedSharding(mesh, _pspec())
+
+            def gather(params):
+                return plan.gather_params(params, mesh)
+            gather.__name__ = "mxtpu_zero_gather"
+            self._gather_fn = jax.jit(gather, out_shardings=rep)
+            self._san_gather.miss({"params": len(self.param_names)})
+        if _san._collective_on:
+            # ledger entry at dispatch, from shape metadata (no sync)
+            _san.note_collective(
+                "mxtpu_zero_gather", name="params",
+                sig=("%d tensors" % len(params),), axes="dp")
+        if _tel._enabled:
+            with _tel.span("zero.gather", cat="distributed",
+                           level=self.zero, tensors=len(params)):
+                out = self._gather_fn(params)
+                with _san.allow_sync("zero.gather telemetry span"):
+                    jax.block_until_ready(out)
+            return out
+        return self._gather_fn(params)
+
+    def zero_bytes(self, params, opt_state=None):
+        """Per-device {param, grad, opt} byte residency of this step's
+        placement plan — shape metadata only, readable with telemetry
+        off (the ``zero_param_bytes``/``zero_grad_bytes`` gauge source
+        and the dryrun ladder's memory stamp)."""
+        return self.plan.per_device_bytes(params, opt_state)
+
     # ----------------------------------------------------------- checkpoint
     def checkpoint_topology(self):
         """Shard-ownership description for the sharded checkpoint writer
         (mxnet_tpu/checkpoint.py): which stage owns each parameter/aux
         tensor (all stage 0 here — one program), and how the optimizer
-        state is laid out (ZeRO-1 flat ``(dp, chunk)`` shards or
-        replicated).  The writer turns this into one shard file per
-        ownership group instead of N ranks racing to clobber one
-        monolithic ``.params``."""
-        return {"pp": 1,
+        state is laid out (ZeRO flat ``(dp, chunk)`` shards or
+        replicated; ``zero`` carries the LEVEL — at level 3 the
+        parameters themselves are flat rows and ``param_shapes`` records
+        their logical shapes for the writer/reader).  The writer turns
+        this into one shard file per ownership group instead of N ranks
+        racing to clobber one monolithic ``.params``."""
+        topo = {"pp": 1,
                 "dp": self._dp,
                 "zero": self.zero,
                 "microbatches": None,
-                "stage_of": {n: 0 for n in self.param_names + self.aux_names}}
+                "stage_of": {n: 0 for n in self.param_names
+                             + self.aux_names}}
+        if self.zero >= 3:
+            topo["param_shapes"] = {n: list(self.plan.shape_of(n))
+                                    for n in self.param_names}
+        return topo
 
     def place_checkpoint(self, host_params, host_state, host_aux,
                          device=None):
@@ -653,6 +747,7 @@ class TrainStep(object):
         import jax
         params = {n: _np.asarray(host_params[n]) for n in self.param_names}
         aux = {n: _np.asarray(host_aux[n]) for n in self.aux_names}
+        self.plan.note_host(params)
         if self.zero:
             state = {n: tuple(_flat_np(s, self._dp)
                               for s in host_state[n])
@@ -682,8 +777,15 @@ class TrainStep(object):
             if n in self.param_shardings:
                 return NamedSharding(self.mesh, self.param_shardings[n])
             return rep
-        params = {n: jax.device_put(v, shard_of(n))
-                  for n, v in params.items()}
+        if self.zero >= 3:
+            # ZeRO-3: parameters live as flat (dp, chunk) shards —
+            # re-chunked to THIS mesh's dp, whatever topology saved them
+            sh_dp = NamedSharding(self.mesh, _pspec("dp"))
+            params = {n: jax.device_put(_flat_np(v, self._dp), sh_dp)
+                      for n, v in params.items()}
+        else:
+            params = {n: jax.device_put(v, shard_of(n))
+                      for n, v in params.items()}
         if self.zero:
             sh_dp = NamedSharding(self.mesh, _pspec("dp"))
             state = {n: tuple(jax.device_put(s, sh_dp) for s in st)
@@ -781,6 +883,7 @@ class TrainStep(object):
         params, aux = _host_init(self.symbol, self._low, self.param_names,
                                  self.aux_names, data_shapes, label_shapes,
                                  initializer, seed, "TrainStep")
+        self.plan.note_host(params)
         if self.zero:
             # optimizer state is born sharded over dp
             opt_state = _zero_state_host(self.fopt, params, self._dp)
@@ -819,10 +922,16 @@ class TrainStep(object):
                 if n in self.param_shardings:
                     return NamedSharding(self.mesh, self.param_shardings[n])
                 return rep
-            params = {n: jax.device_put(v, shard_of(n))
-                      for n, v in params.items()}
+            if self.zero >= 3:
+                # ZeRO-3: parameters are born as flat (dp, chunk) shards
+                sh_dp3 = NamedSharding(self.mesh, _pspec("dp"))
+                params = {n: jax.device_put(_flat_np(v, self._dp), sh_dp3)
+                          for n, v in params.items()}
+            else:
+                params = {n: jax.device_put(v, shard_of(n))
+                          for n, v in params.items()}
             if self.zero:
-                # ZeRO-1: optimizer state lives permanently sharded over dp
+                # ZeRO: optimizer state lives permanently sharded over dp
                 sh_dp = NamedSharding(self.mesh, _pspec("dp"))
                 opt_state = {n: tuple(jax.device_put(s, sh_dp) for s in st)
                              for n, st in opt_state.items()}
@@ -1013,6 +1122,15 @@ class TrainStep(object):
                 _tel.gauge("loss_scale", scale)
                 if overflow:
                     _tel.counter("amp_overflow_steps", overflow)
+        if _tel._enabled and self.zero:
+            # per-device residency per the placement plan — shape
+            # metadata only, no syncs (strict no-op with telemetry off);
+            # invariant for a step instance, so walked once and cached
+            zb = self._zb_cache
+            if zb is None:
+                zb = self._zb_cache = self.zero_bytes(res[0], res[1])
+            _tel.gauge("zero_param_bytes", zb["param"], level=self.zero)
+            _tel.gauge("zero_grad_bytes", zb["grad"], level=self.zero)
         if _diag._armed:
             _diag.heartbeat(train_step=self.num_update)
         mode = _diag.check_numerics_mode() if self.check_numerics else None
@@ -1127,8 +1245,16 @@ class PipelineTrainStep(object):
       donated on the final stage's sub-mesh; per-stage finite flags
       combine there ON DEVICE, and each stage's update skips in a
       ``lax.cond`` on overflow — no host syncs.
-    - **ZeRO-1** (``zero=True``): each stage's optimizer step shards over
-      its sub-mesh's dp axis exactly like ``TrainStep(zero=True)``.
+    - **ZeRO levels** (``zero=0|1|2|3``; bool accepted — ``True`` is
+      level 1): the placement plan applies per stage over its sub-mesh's
+      dp axis exactly like ``TrainStep``.  Level 1 shards each stage's
+      optimizer step; level 2 makes the stage's flat ``(dp, chunk)``
+      gradient bucket the ONLY gradient residency on every schedule
+      (one all-gather of updated params per stage per step); level 3
+      shards the stage's parameters themselves — the stage fwd/bwd
+      programs gather full weights just-in-time and free them when the
+      program retires, so per-device model footprint scales
+      ~1/(pp*dp).  See docs/distributed.md "ZeRO levels".
     - **donation**: per-stage params/optimizer state (and the loss-scale
       state) are donated to their update programs; gradient accumulators
       are donated through the backward wave.
@@ -1218,11 +1344,23 @@ class PipelineTrainStep(object):
         # directly; no gather at all).  GPipe keeps PR 10's byte-identical
         # in-program reduction.
         self._overlap = self._dp > 1 and self._schedule != "gpipe"
-        self.zero = bool(zero)
+        # ZeRO levels compose with every schedule (the placement plan is
+        # a schedule-orthogonal knob — docs/distributed.md "ZeRO
+        # levels"): level >= 2 makes the per-stage flat (dp, chunk)
+        # bucket the ONLY gradient residency on every schedule (not just
+        # the overlapped v2 paths), level 3 shards each stage's
+        # parameters over its sub-mesh's dp and gathers them
+        # just-in-time inside the stage's fwd/bwd programs — per-device
+        # model footprint scales ~1/(pp*dp).
+        self.zero = normalize_zero(zero)
         if self.zero and "dp" not in mesh.axis_names:
             raise MXNetError(
-                "PipelineTrainStep(zero=True) needs a mesh with a 'dp' "
-                "axis to shard the optimizer over")
+                "PipelineTrainStep(zero=%d) needs a mesh with a 'dp' "
+                "axis to shard over" % self.zero)
+        self._bucket = self.zero >= 2 or self._overlap
+        self.plan = PlacementPlan(zero=self.zero, dp=self._dp,
+                                  who="PipelineTrainStep")
+        self._zb_cache = None   # zero_*_bytes gauge memo (step-invariant)
         self._dtype = dtype
         self._low = _Lowered(symbol)
         self.data_names = tuple(data_names)
@@ -1374,16 +1512,31 @@ class PipelineTrainStep(object):
         return self._var_stage[name]
 
     def param_sharding(self, name):
-        """Replicated NamedSharding on ``name``'s stage sub-mesh."""
+        """NamedSharding of ``name``'s RESIDENT parameter buffer on its
+        stage sub-mesh: replicated below ZeRO level 3, flat dp-sharded
+        at level 3 (the placement plan's spec)."""
+        from jax.sharding import NamedSharding
+        return NamedSharding(self._sub(self._stage_of_var(name)),
+                             self.plan.param_spec(name))
+
+    def _rep_sharding(self, name):
+        """Replicated NamedSharding on ``name``'s stage sub-mesh (aux
+        state stays replicated at every ZeRO level)."""
         from jax.sharding import NamedSharding
         return NamedSharding(self._sub(self._stage_of_var(name)), _pspec())
 
     def place_params(self, host_params):
         """Host {name: array} -> per-stage device placement (finalising
-        the stage plan from the real parameter sizes on first use)."""
+        the stage plan from the real parameter sizes on first use;
+        ZeRO-3 flattens each tensor to its (dp, chunk) shards)."""
         import jax
         self._ensure_plan({n: int(_np.asarray(v).size)
                            for n, v in host_params.items()})
+        self.plan.note_host(host_params)
+        if self.zero >= 3:
+            return {n: jax.device_put(_flat_np(v, self._dp),
+                                      self.param_sharding(n))
+                    for n, v in host_params.items()}
         return {n: jax.device_put(_np.asarray(v), self.param_sharding(n))
                 for n, v in host_params.items()}
 
@@ -1391,8 +1544,33 @@ class PipelineTrainStep(object):
         import jax
         if self._stages is None:
             raise MXNetError("PipelineTrainStep: place_params() first")
-        return {n: jax.device_put(_np.asarray(v), self.param_sharding(n))
+        return {n: jax.device_put(_np.asarray(v), self._rep_sharding(n))
                 for n, v in host_aux.items()}
+
+    def unflatten_host(self, name, arr):
+        """Host flat (dp, chunk) array -> the logical tensor (sync-back/
+        export half of the ZeRO-3 layout)."""
+        return self.plan.unflatten_host(name, arr)
+
+    def zero_bytes(self, params, opt_state=None):
+        """Worst-slice per-device {param, grad, opt} byte residency of
+        the placement plan — shape metadata only (the ``zero_*_bytes``
+        gauge source; readable with telemetry off)."""
+        per = {}
+        for st in self._stages:
+            d = st.index % self._pp
+            sub_p = {n: params[n] for n in st.params}
+            sub_s = {n: opt_state[n] for n in st.params} \
+                if opt_state is not None else None
+            zb = self.plan.per_device_bytes(sub_p, sub_s)
+            acc = per.setdefault(d, {"param": 0, "grad": 0, "opt": 0})
+            for k in acc:
+                acc[k] += zb[k]
+        out = {"param": 0, "grad": 0, "opt": 0}
+        for d, zb in per.items():
+            for k in out:
+                out[k] = max(out[k], zb[k])
+        return out
 
     def place_state(self, host_state):
         """Host optimizer state {name: tuple(arrays)} -> stage placement
@@ -1418,10 +1596,8 @@ class PipelineTrainStep(object):
                                  self.aux_names, data_shapes, label_shapes,
                                  initializer, seed, "PipelineTrainStep")
         self._ensure_plan({n: int(v.size) for n, v in params.items()})
-        dev_params = {n: jax.device_put(v, self.param_sharding(n))
-                      for n, v in params.items()}
-        dev_aux = {n: jax.device_put(v, self.param_sharding(n))
-                   for n, v in aux.items()}
+        dev_params = self.place_params(params)
+        dev_aux = self.place_aux(aux)
         if self.zero:
             host_state = _zero_state_host(self.fopt, params, self._dp)
             dev_state = {}
@@ -1460,13 +1636,19 @@ class PipelineTrainStep(object):
                 "PipelineTrainStep.checkpoint_topology: call init() or "
                 "place_params() first — the stage plan is balanced from "
                 "parameter sizes")
-        return {"pp": self._pp,
+        topo = {"pp": self._pp,
                 "dp": self._dp,
                 "zero": self.zero,
                 "microbatches": self._micro,
                 "schedule": self._schedule,
                 "interleave": self._v,
                 "stage_of": dict(self._var_stage)}
+        if self.zero >= 3:
+            # level 3 param buffers are flat rows — the writer needs the
+            # logical shapes to stamp the manifest restore contract
+            topo["param_shapes"] = {n: list(self.plan.shape_of(n))
+                                    for n in self.param_names}
+        return topo
 
     def place_checkpoint(self, host_params, host_state, host_aux,
                          device=None):
@@ -1551,7 +1733,17 @@ class PipelineTrainStep(object):
         rep = NamedSharding(sub, _pspec())
         micro = self._micro
 
+        plan = self.plan
+        zero3 = self.zero >= 3
+
         def run_fwd(params, aux, carry, extra, rng, scale=None):
+            if zero3:
+                # ZeRO-3: the stage's resident params are flat (dp,
+                # chunk) shards — gather the full weights just-in-time
+                # (freed when the stage program retires; the bwd vjp
+                # transposes this gather into the reduce-scatter that
+                # lands each device's gradient shard)
+                params = plan.gather_params(params, sub)
             vals = dict(extra)
             if dtype is not None:
                 # data inputs cast, labels kept (bfloat16 rounds class
@@ -1580,7 +1772,11 @@ class PipelineTrainStep(object):
         names = list(stage.params)
         dp = self._dp
         sh_dp = NamedSharding(sub, _pspec("dp"))
-        overlap = self._overlap
+        # the flat (dp, chunk) bucket is the gradient residency when the
+        # overlapped dp comm engages (v2 schedules, dp > 1) OR at ZeRO
+        # level >= 2 on ANY schedule (the bucket is then the only place
+        # gradients ever live)
+        overlap = self._bucket
 
         def bucket_chunks(params):
             """Static (name, chunk_rows) layout of this stage's flat
@@ -1712,13 +1908,21 @@ class PipelineTrainStep(object):
 
         if kind == "upd":
             zero = self.zero
-            # ZeRO + overlap: the update consumes the flat (dp, chunk)
+            # ZeRO + bucket: the update consumes the flat (dp, chunk)
             # gradient bucket directly — the reduce-scatters inside the
             # backward wave already placed each device's shard, so the
             # stage's dp communication is DONE when its backward finishes
             bucket = overlap and zero
 
             def upd_math(params, grads, opt_state, hyper, t, rng):
+                if zero >= 2:
+                    # levels 2/3: the plan's sharded update over the
+                    # stage bucket — level 2 re-materialises replicated
+                    # params with ONE all-gather of the updated rows,
+                    # level 3 keeps params as resident flat shards
+                    return plan.shard_update(
+                        self.fopt, params, grads, bucket_chunks(params),
+                        opt_state, hyper, t, rng, sub)
                 gfs = None
                 if bucket:
                     gfs, off = {}, 0
@@ -1770,10 +1974,12 @@ class PipelineTrainStep(object):
                     return upd_math(params, acc, opt_state, hyper, t, rng)
             upd.__name__ = "mxtpu_pp_upd"
             state_sh = sh_dp if zero else rep
+            # ZeRO-3: updated params stay resident as flat shards
+            param_sh = sh_dp if zero >= 3 else rep
             # the lax.cond defeats GSPMD output-sharding propagation —
             # pin outputs to the carried layout (mirrors TrainStep)
             return jax.jit(upd, donate_argnums=(0, 1),
-                           out_shardings=(rep, state_sh))
+                           out_shardings=(param_sh, state_sh))
 
         if kind == "fin":
             def fin(acc):
@@ -1927,7 +2133,7 @@ class PipelineTrainStep(object):
         if _san._donate_on:
             _san.check_donated("pipeline_step", self._donate_pairs(args_led))
         nbytes = _tel.nbytes_of
-        gather_grads = self._overlap and not self.zero
+        gather_grads = self._bucket and not self.zero
         with _profiler.Scope("pipeline_step[%d]" % self.num_update,
                              "symbolic"), \
                 _san.hot_region("pipeline_step"):
@@ -2083,8 +2289,13 @@ class PipelineTrainStep(object):
         static_nb = [0] * P
         for k in range(V):
             st = self._stages[k]
-            nb = sum(nbytes(new_params[n]) for n in st.params)
-            nb += sum(nbytes(x) for n in st.params for x in new_state[n])
+            # dp-flat-sharded leaves (ZeRO params at level 3, state at
+            # level >= 1) cost each device 1/dp of the array
+            pdiv = self._dp if self.zero >= 3 else 1
+            sdiv = self._dp if self.zero else 1
+            nb = sum(nbytes(new_params[n]) // pdiv for n in st.params)
+            nb += sum(nbytes(x) // sdiv
+                      for n in st.params for x in new_state[n])
             nb += sum(nbytes(new_aux[n]) for n in st.aux)
             static_nb[k % P] += nb
         self.last_live_bytes = [static_nb[d] + peak_nb[d]
@@ -2113,6 +2324,17 @@ class PipelineTrainStep(object):
                 _tel.gauge("loss_scale", scale_v)
                 if overflow:
                     _tel.counter("amp_overflow_steps", overflow)
+            if self.zero:
+                # worst-slice per-device residency per the placement
+                # plan — shape metadata only, no syncs; invariant for a
+                # step instance, so walked once and cached
+                zb = self._zb_cache
+                if zb is None:
+                    zb = self._zb_cache = self.zero_bytes(new_params,
+                                                          new_state)
+                _tel.gauge("zero_param_bytes", zb["param"],
+                           level=self.zero)
+                _tel.gauge("zero_grad_bytes", zb["grad"], level=self.zero)
         if _diag._armed:
             _diag.heartbeat(pipeline_step=self.num_update)
         mode = _diag.check_numerics_mode() if self.check_numerics else None

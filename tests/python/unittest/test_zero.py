@@ -1,11 +1,21 @@
-"""ZeRO-1 optimizer sharding (TrainStep(zero=True)).
+"""ZeRO levels 0-3 (TrainStep/PipelineTrainStep ``zero=`` + ``MXNET_ZERO``).
 
 Pins, on the virtual 8-device CPU mesh:
-- f64 parity: one fused step in zero mode matches replicated mode exactly
-  (elementwise optimizer math commutes with the flat (dp, chunk) view);
+- f64 parity: one fused step at any zero level matches replicated mode
+  exactly (elementwise optimizer math commutes with the flat (dp, chunk)
+  view) — fast f32 2e-5 matrix over zero∈{2,3} × {dp, dp×pp per
+  schedule}, slow f64 @1e-9 twin;
 - the compiled step really reduce-scatters gradients (HLO check) instead
   of all-reducing them into replicated optimizer state;
-- optimizer state is born sharded over dp (1/dp of it on each device).
+- optimizer state is born sharded over dp (1/dp of it on each device);
+  level-3 parameters are born as flat (dp, chunk) shards;
+- AMP overflow-skip under zero3 leaves the sharded masters untouched;
+- the ``MXNET_ZERO`` fit dispatch (engages/toggles/guards byte-identical
+  when unset), donation-ledger + ``MXNET_SAN=all:raise`` cleanliness
+  with the ``zero.gather`` program in the collective ledger, the
+  ``zero_param_bytes``/``zero_grad_bytes`` gauges (strict no-op off),
+  and the live-bytes pin (zero3 per-device param residency <
+  replicated's).
 """
 import numpy as np
 import pytest
@@ -14,8 +24,10 @@ import jax
 import jax.numpy as jnp
 
 import mxnet_tpu as mx
-from mxnet_tpu.parallel.mesh import make_mesh
-from mxnet_tpu.train import TrainStep
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel.mesh import make_mesh, make_pp_mesh
+from mxnet_tpu.parallel.placement import PlacementPlan, normalize_zero
+from mxnet_tpu.train import TrainStep, PipelineTrainStep
 
 
 @pytest.fixture
@@ -140,3 +152,409 @@ def test_reduce_scatter_hlo_supported_on_cpu():
 def test_zero_requires_dp_mesh():
     with pytest.raises(mx.base.MXNetError):
         TrainStep(_net(), mx.optimizer.SGD(), mesh=None, zero=True)
+
+
+# ===================================================== ZeRO levels 2 / 3
+BATCH = 8
+
+
+def _mlp(classes=4):
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, name="fc1", num_hidden=16)
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, name="fc2", num_hidden=16)
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, name="fc3", num_hidden=classes)
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def _mlp_batch(dtype=np.float32, seed=0):
+    rs = np.random.RandomState(seed)
+    return {"data": rs.uniform(-1, 1, (BATCH, 10)).astype(dtype),
+            "softmax_label": rs.randint(0, 4, (BATCH,)).astype(dtype)}
+
+
+def _sgd():
+    return mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=1e-4,
+                            rescale_grad=1.0 / BATCH)
+
+
+def _cast64(p, s, a):
+    return ({k: v.astype(jnp.float64) for k, v in p.items()},
+            {k: tuple(x.astype(jnp.float64) for x in st)
+             for k, st in s.items()},
+            {k: v.astype(jnp.float64) for k, v in a.items()})
+
+
+def _host_logical(ts, params):
+    if getattr(ts, "zero", 0) >= 3:
+        return {n: ts.unflatten_host(n, np.asarray(v))
+                for n, v in params.items()}
+    return {n: np.asarray(v) for n, v in params.items()}
+
+
+def _run_level(zero, pp=0, dp=8, M=2, schedule="gpipe", f64=False,
+               steps=2, policy=None):
+    dt = np.float64 if f64 else np.float32
+    if pp:
+        ts = PipelineTrainStep(
+            _mlp(), _sgd(),
+            mesh=make_pp_mesh(pp, dp=dp, devices=jax.devices()[:pp * dp]),
+            num_microbatches=M, zero=zero, schedule=schedule,
+            policy=policy)
+    elif zero:
+        ts = TrainStep(_mlp(), _sgd(),
+                       mesh=make_mesh({"dp": dp},
+                                      devices=jax.devices()[:dp]),
+                       zero=zero, policy=policy)
+    else:
+        ts = TrainStep(_mlp(), _sgd(), policy=policy)
+    p, s, a = ts.init({"data": (BATCH, 10)}, {"softmax_label": (BATCH,)})
+    if f64:
+        p, s, a = _cast64(p, s, a)
+    b = ts.shard_batch(_mlp_batch(dt))
+    key = jax.random.PRNGKey(7)
+    for _ in range(steps):
+        p, s, a, outs = ts(p, s, a, b, rng=key)
+    return ts, p, s, a
+
+
+@pytest.mark.parametrize("zero", [2, 3])
+@pytest.mark.parametrize("cfg", [
+    ("dp8", 0, 8, "gpipe"),
+    ("dp2xpp2-gpipe", 2, 2, "gpipe"),
+    ("dp2xpp2-1f1b", 2, 2, "1f1b"),
+    ("dp2xpp2-interleaved", 2, 2, "interleaved"),
+], ids=lambda c: c[0] if isinstance(c, tuple) else c)
+def test_zero23_parity_matrix_f32(zero, cfg):
+    """zero∈{2,3} × {dp, dp×pp per schedule} matches the replicated
+    single-program step at f32 2e-5 (collective/summation reorder
+    noise); the slow f64 twin pins @1e-9."""
+    _name, pp, dp, schedule = cfg
+    _, p_ref, _, a_ref = _run_level(0)
+    ts, p, s, a = _run_level(zero, pp=pp, dp=dp, M=2, schedule=schedule)
+    ph = _host_logical(ts, p)
+    for n in p_ref:
+        np.testing.assert_allclose(ph[n], np.asarray(p_ref[n]),
+                                   rtol=2e-5, atol=1e-6,
+                                   err_msg="zero=%d %s %s"
+                                           % (zero, cfg[0], n))
+    # sharded residency: state rows at any level, params too at level 3
+    for n, st in s.items():
+        for leaf in st:
+            assert leaf.shape[0] == ts.plan.dp, (n, leaf.shape)
+    if zero >= 3:
+        for n, v in p.items():
+            assert v.shape[0] == ts.plan.dp, (n, v.shape)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("zero", [2, 3])
+@pytest.mark.parametrize("cfg", [
+    ("dp8", 0, 8, "gpipe"),
+    ("dp2xpp2-gpipe", 2, 2, "gpipe"),
+    ("dp2xpp2-1f1b", 2, 2, "1f1b"),
+    ("dp2xpp2-interleaved", 2, 2, "interleaved"),
+], ids=lambda c: c[0] if isinstance(c, tuple) else c)
+def test_zero23_parity_matrix_f64(zero, cfg, f64):
+    _name, pp, dp, schedule = cfg
+    _, p_ref, _, _ = _run_level(0, f64=True)
+    ts, p, _, _ = _run_level(zero, pp=pp, dp=dp, schedule=schedule,
+                             f64=True)
+    ph = _host_logical(ts, p)
+    for n in p_ref:
+        np.testing.assert_allclose(ph[n], np.asarray(p_ref[n]),
+                                   rtol=1e-9, atol=1e-12,
+                                   err_msg="zero=%d %s %s"
+                                           % (zero, cfg[0], n))
+
+
+def test_normalize_zero_levels_and_bool_compat():
+    assert normalize_zero(False) == 0 and normalize_zero(True) == 1
+    assert [normalize_zero(v) for v in (0, 1, 2, 3)] == [0, 1, 2, 3]
+    with pytest.raises(MXNetError):
+        normalize_zero(4)
+    with pytest.raises(MXNetError):
+        normalize_zero(-1)
+    with pytest.raises(MXNetError):
+        TrainStep(_mlp(), _sgd(), mesh=make_mesh({"dp": 8}), zero=7)
+
+
+def test_zero3_gather_params_and_roundtrip():
+    """gather_params materialises logical replicated weights equal to the
+    host unpad of the flat shards; below level 3 it is the identity."""
+    ts, p, s, a = _run_level(3, steps=1)
+    full = ts.gather_params(p)
+    for n in p:
+        want = ts.unflatten_host(n, np.asarray(p[n]))
+        got = np.asarray(full[n])
+        assert got.shape == want.shape
+        np.testing.assert_array_equal(got, want, err_msg=n)
+    ts1, p1, _, _ = _run_level(1, steps=1)
+    assert ts1.gather_params(p1) is p1
+
+
+def test_zero_bytes_staircase():
+    """The plan's per-device residency walks the ladder: opt drops at
+    level 1, grad at level 2, param at level 3 — and the zero3 param
+    residency sits strictly below replicated/level-1's (the live-bytes
+    pin)."""
+    got = {}
+    for level in (1, 2, 3):
+        ts, p, s, _ = _run_level(level, steps=1)
+        got[level] = ts.zero_bytes(p, s)
+    # state always sharded at >= 1; gradient residency shrinks at 2
+    assert got[2]["grad"] < got[1]["grad"]
+    assert got[2]["param"] == got[1]["param"]
+    # the level-3 pin: per-device params strictly below replicated's
+    assert got[3]["param"] < got[1]["param"]
+    assert got[3]["param"] <= -(-got[1]["param"] // 8) + 64
+    assert got[3]["grad"] == got[2]["grad"]
+
+
+def test_zero3_amp_overflow_skip_preserves_sharded_masters():
+    """An overflow step under zero3 must skip the update without
+    corrupting the sharded f32 masters or the sharded optimizer state,
+    and the scale must halve (mirrors the replicated AMP pin)."""
+    from mxnet_tpu.amp import Policy
+    pol = Policy("float32", loss_scale=16.0, growth_interval=50)
+    ts = TrainStep(_mlp(), _sgd(), mesh=make_mesh({"dp": 8}), zero=3,
+                   policy=pol)
+    p, s, a = ts.init({"data": (BATCH, 10)}, {"softmax_label": (BATCH,)})
+    bad = _mlp_batch()
+    bad["data"][0, 0] = np.inf
+    bd = ts.shard_batch(bad)
+    before = {k: np.asarray(v).copy() for k, v in p.items()}
+    st_before = {k: tuple(np.asarray(x).copy() for x in st)
+                 for k, st in s.items()}
+    p, s, a, outs = ts(p, s, a, bd)
+    for k in before:
+        np.testing.assert_array_equal(before[k], np.asarray(p[k]),
+                                      err_msg=k)
+        for m0, m1 in zip(st_before[k], s[k]):
+            np.testing.assert_array_equal(m0, np.asarray(m1))
+    host = jax.device_get(ts._scale_state)
+    assert float(host["scale"]) == 8.0 and int(host["overflow"]) == 1
+    # and a clean step afterwards still updates the sharded masters
+    good = ts.shard_batch(_mlp_batch())
+    p, s, a, _ = ts(p, s, a, good)
+    assert any(not np.array_equal(before[k], np.asarray(p[k]))
+               for k in before)
+
+
+def test_zero23_checkpoint_topology_carries_level():
+    for level in (2, 3):
+        ts, p, s, a = _run_level(level, steps=1)
+        topo = ts.checkpoint_topology()
+        assert topo["zero"] == level
+        if level >= 3:
+            assert topo["param_shapes"]["fc1_weight"] == [16, 10]
+
+
+def test_zero_gauges_and_strict_noop(tmp_path):
+    from mxnet_tpu import telemetry as tel
+    tel.start(str(tmp_path / "t.jsonl"))
+    try:
+        ts, p, s, a = _run_level(3, steps=1)
+        b = ts.shard_batch(_mlp_batch())
+        p, s, a, _ = ts(p, s, a, b)
+        gauges = tel.gauges()
+        assert gauges["zero_param_bytes"] == ts.zero_bytes(p, s)["param"]
+        assert gauges["zero_grad_bytes"] == ts.zero_bytes(p, s)["grad"]
+        ts.gather_params(p)
+        assert any(e.get("name") == "zero.gather" for e in tel.events())
+    finally:
+        tel.stop()
+    # strict no-op: with telemetry off a zero step emits nothing (the
+    # registry keeps the last session's values; no NEW update may land —
+    # a level-2 resnet step would write different byte values)
+    g0 = dict(tel.gauges())
+    ts, p, s, a = _run_level(2, steps=1)
+    assert tel.gauges().get("zero_param_bytes") \
+        == g0.get("zero_param_bytes")
+    assert tel.gauges().get("zero_grad_bytes") == g0.get("zero_grad_bytes")
+
+
+def test_zero_sanitized_e2e_and_gather_in_ledger():
+    """A zero3 train + gather under MXNET_SAN=all:raise runs clean
+    (donation ledger, recompile budget, hot-path syncs, collective
+    ledger), and the zero.gather dispatch lands in the collective
+    ledger."""
+    from mxnet_tpu import sanitize as san
+    san.arm("recompile,sync,donate,collective", mode="raise")
+    try:
+        ts, p, s, a = _run_level(3, steps=3)
+        full = ts.gather_params(p)
+        jax.block_until_ready(jax.tree_util.tree_leaves(full)[0])
+        ledger = san.ledger_tail(64)
+        assert any(e["kind"] == "mxtpu_zero_gather" for e in ledger)
+        assert not san.violations()
+    finally:
+        san.disarm()
+
+
+def test_zero3_donation_ledger_names_reuse():
+    """Re-stepping with the donated flat shards is named by the DONATE
+    checker before XLA's cryptic deleted-buffer crash."""
+    from mxnet_tpu import sanitize as san
+    ts, p, s, a = _run_level(3, steps=1)
+    b = ts.shard_batch(_mlp_batch())
+    san.arm("donate", mode="raise")
+    try:
+        p1, s1, a1, _ = ts(p, s, a, b)
+        with pytest.raises(san.SanitizerError):
+            ts(p, s, a, b)   # p/s/a were donated into the previous step
+    finally:
+        san.disarm()
+
+
+# ------------------------------------------------------ MXNET_ZERO dispatch
+def _fit_data(seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.uniform(-1, 1, (64, 16)).astype(np.float32)
+    w = rs.uniform(-1, 1, (16,))
+    y = (x @ w > 0).astype(np.float32)
+    return mx.io.NDArrayIter(x, y, batch_size=16, shuffle=False,
+                             label_name="softmax_label")
+
+
+def _fit_net(classes=2):
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, name="fc1", num_hidden=32)
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, name="fc2", num_hidden=classes)
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+@pytest.mark.parametrize("level", [2, 3])
+def test_zero_fit_dispatch_trains(monkeypatch, level):
+    monkeypatch.setenv("MXNET_ZERO", str(level))
+    data = _fit_data()
+    mod = mx.Module(_fit_net(), context=mx.cpu())
+    mod.fit(data, num_epoch=4, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(), eval_metric="acc")
+    ts = mod._fused_ts_cache[1]
+    assert isinstance(ts, TrainStep) and ts.zero == level
+    assert ts.mesh is not None and ts.plan.dp == len(jax.devices())
+    data.reset()
+    score = dict(mod.score(data, mx.metric.Accuracy()))
+    assert score["accuracy"] > 0.8, score
+    # get_params returns LOGICAL shapes even at level 3
+    arg, _aux = mod.get_params()
+    assert arg["fc1_weight"].shape == (32, 16)
+
+
+def test_zero_fit_env_unset_is_plain_fused_path(monkeypatch):
+    monkeypatch.delenv("MXNET_ZERO", raising=False)
+    data = _fit_data()
+    mod = mx.Module(_fit_net(), context=mx.cpu())
+    mod.fit(data, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    ts = mod._fused_ts_cache[1]
+    assert isinstance(ts, TrainStep) and ts.zero == 0 and ts.mesh is None
+
+
+def test_zero_fit_toggle_rebuilds_via_cache_key(monkeypatch):
+    monkeypatch.delenv("MXNET_ZERO", raising=False)
+    data = _fit_data()
+    mod = mx.Module(_fit_net(), context=mx.cpu())
+    mod.fit(data, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    assert mod._fused_ts_cache[1].zero == 0
+    monkeypatch.setenv("MXNET_ZERO", "2")
+    data.reset()
+    mod.fit(data, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    ts2 = mod._fused_ts_cache[1]
+    assert ts2.zero == 2
+    # same level reuses the cached step; unset restores the plain path
+    data.reset()
+    mod.fit(data, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    assert mod._fused_ts_cache[1] is ts2
+    monkeypatch.delenv("MXNET_ZERO")
+    data.reset()
+    mod.fit(data, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    assert mod._fused_ts_cache[1].zero == 0
+
+
+def test_zero_fit_indivisible_batch_raises(monkeypatch):
+    # the dp mesh shards each batch over all local devices — an
+    # indivisible batch is a curated error at dispatch, not an obscure
+    # jit sharding failure at the first step
+    monkeypatch.setenv("MXNET_ZERO", "2")
+    rs = np.random.RandomState(0)
+    x = rs.uniform(-1, 1, (18, 16)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    data = mx.io.NDArrayIter(x, y, batch_size=6,
+                             label_name="softmax_label")
+    mod = mx.Module(_fit_net(), context=mx.cpu())
+    with pytest.raises(MXNetError, match="not divisible"):
+        mod.fit(data, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1})
+
+
+def test_zero_fit_bad_level_raises(monkeypatch):
+    monkeypatch.setenv("MXNET_ZERO", "5")
+    data = _fit_data()
+    mod = mx.Module(_fit_net(), context=mx.cpu())
+    with pytest.raises(MXNetError):
+        mod.fit(data, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1})
+
+
+def test_run_compare_zero_block_gate(tmp_path):
+    """run_compare ingests the dryrun's `zero` block: per-device byte
+    metrics gate with down-direction hints, the config block is
+    identity (a level change is never a regression pair), and the
+    committed MULTICHIP_ZERO_r01.json self-compares rc=0."""
+    import json
+    import os
+    from tools import run_compare as rc
+
+    def record(param_mb, grad_mb, level=3):
+        return {"metric": "zero3_param_bytes_mb", "value": param_mb,
+                "zero": {"zero_param_bytes_mb": param_mb,
+                         "zero_grad_bytes_mb": grad_mb,
+                         "zero_opt_bytes_mb": grad_mb,
+                         "config": {"zero": level, "dp": 4, "pp": 0}}}
+
+    base = tmp_path / "a.json"
+    base.write_text(json.dumps(record(10.0, 5.0)))
+    same = tmp_path / "b.json"
+    same.write_text(json.dumps(record(10.0, 5.0)))
+    worse = tmp_path / "c.json"
+    worse.write_text(json.dumps(record(20.0, 5.0)))
+    other = tmp_path / "d.json"
+    other.write_text(json.dumps(record(40.0, 40.0, level=1)))
+    assert rc.main([str(base), str(same), "--check"]) == 0
+    # per-device param bytes going UP is a REGRESSION (down-hint)
+    assert rc.main([str(base), str(worse), "--check"]) == 2
+    # a different ZeRO level is a different experiment, not a regression
+    assert rc.main([str(base), str(other), "--check"]) == 0
+    run = rc.load_run(str(base))
+    assert run.bench["zero_param_bytes_mb"] == pytest.approx(10.0)
+    assert "config" not in run.bench
+    committed = os.path.join(os.path.dirname(__file__), "..", "..", "..",
+                             "MULTICHIP_ZERO_r01.json")
+    assert rc.main([committed, committed, "--check"]) == 0
+    rec = rc.load_run(committed)
+    assert rec.bench["zero_param_bytes_mb"] > 0
+
+
+def test_zero_fit_composes_with_pp(monkeypatch):
+    monkeypatch.setenv("MXNET_ZERO", "3")
+    monkeypatch.setenv("MXNET_PP", "2")
+    monkeypatch.setenv("MXNET_PP_MICROBATCH", "2")
+    data = _fit_data()
+    mod = mx.Module(_fit_net(), context=mx.cpu())
+    mod.fit(data, num_epoch=4, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(), eval_metric="acc")
+    ts = mod._fused_ts_cache[1]
+    assert isinstance(ts, PipelineTrainStep) and ts.zero == 3
+    data.reset()
+    score = dict(mod.score(data, mx.metric.Accuracy()))
+    assert score["accuracy"] > 0.8, score

@@ -147,8 +147,11 @@ def render(summary, out=sys.stdout):
     out.write("step   %s  (epoch %s, batch %s)  format v%s\n"
               % (summary["step"], summary["epoch"], summary["nbatch"],
                  summary["version"]))
+    # zero is the ZeRO LEVEL (0-3); manifests from older runtimes carry
+    # a bool — render both as the level number
     out.write("saved under  pp=%s dp=%s zero=%s world=%s%s\n"
-              % (t.get("pp"), t.get("dp"), t.get("zero"), t.get("world"),
+              % (t.get("pp"), t.get("dp"), int(t.get("zero") or 0),
+                 t.get("world"),
                  "  M=%s" % t["microbatches"]
                  if t.get("microbatches") else ""))
     out.write("opt state    %s    extra: %s\n"

@@ -70,6 +70,14 @@ CACHES = (
     {"name": "serving bucket-rung ladder",
      "key": ("mxnet_tpu/serving.py", "ServedModel._predictor"),
      "roots": ()},     # rung jits land in the executor cache (see above)
+    # the ZeRO-3 params all-gather (zero.gather): one program per
+    # TrainStep instance — a pure reshape + sharding constraint over the
+    # flat (dp, chunk) shards, no env reads at trace time (the
+    # gather-forward step itself lands in the fused-fit / pipeline
+    # caches above, keyed by their trace-env snapshots)
+    {"name": "zero.gather param all-gather",
+     "key": ("mxnet_tpu/train.py", "TrainStep.gather_params"),
+     "roots": ()},
 )
 
 

@@ -50,7 +50,11 @@ _DOWN_HINTS = ("loss", "entropy", "err", "perplexity", "mae", "mse",
                # pipeline-parallel ladder metrics: the fill/drain bubble
                # share and the per-stage memory footprint both regress by
                # going UP (docs/distributed.md "Pipeline parallelism")
-               "bubble", "stage_param", "stage_mem", "live_bytes")
+               "bubble", "stage_param", "stage_mem", "live_bytes",
+               # ZeRO ladder metrics: per-device param/grad/opt-state
+               # residency regresses by going up (docs/distributed.md
+               # "ZeRO levels")
+               "param_bytes", "grad_bytes", "opt_bytes")
 
 _EVENT_TYPES = ("scalar", "span", "counter", "gauge", "hist", "summary")
 
@@ -170,6 +174,27 @@ def _load_bench(run, doc, path):
         run.groups["pipeline"] = names
         if isinstance(pipeline.get("config"), dict):
             run.identity["pipeline"] = dict(pipeline["config"])
+    # zero record (dryrun_multichip's ZeRO ladder): numeric fields are
+    # gated headline metrics — per-device zero_param_bytes/zero_grad_
+    # bytes/zero_opt_bytes regress by going up (direction hints); the
+    # nested config block (zero level / dp / pp) is IDENTITY — two runs
+    # stamped at different levels are different experiments, not a
+    # regression pair
+    zero = rec.get("zero") if isinstance(rec, dict) else None
+    if isinstance(zero, dict):
+        names = set()
+        for k, v in zero.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                run.bench[str(k)] = float(v)
+                names.add(str(k))
+        # a zero* HEADLINE metric (the ladder records stamp their gated
+        # zero3_* residency there too) belongs to the same identity group
+        for name in run.bench:
+            if name.startswith("zero"):
+                names.add(name)
+        run.groups["zero"] = names
+        if isinstance(zero.get("config"), dict):
+            run.identity["zero"] = dict(zero["config"])
     chained = (run.meta or {}).get("telemetry_scalars")
     if chained:
         for candidate in (chained,
