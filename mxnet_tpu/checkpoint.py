@@ -77,7 +77,7 @@ from . import telemetry as _tel
 _LOG = logging.getLogger(__name__)
 
 __all__ = ["Checkpointer", "snapshot", "write_snapshot", "load_manifest",
-           "load_sharded", "restore_into", "latest_sharded",
+           "load_sharded", "reassemble", "restore_into", "latest_sharded",
            "export_monolithic", "verify_checkpoint", "FORMAT", "VERSION"]
 
 FORMAT = "mxtpu-sharded-checkpoint"
@@ -469,20 +469,21 @@ def _iter_shards(path, man, verify=True, parse=True):
 _ZERO_RE = re.compile(r"^stage(\d+)-zero(\d+)$")
 
 
-def load_sharded(path, verify=True):
-    """Load a sharded checkpoint into LOGICAL host pytrees:
-    ``(manifest, params, opt_state, aux)`` with every tensor reassembled
-    to its logical (unsharded, unpadded) shape — ZeRO ``(dp, chunk)``
-    rows concatenated and reshaped, stage files merged.  This is the
-    topology-free half of any-topology restore; placement back onto a
-    (possibly different) mesh is ``place_checkpoint`` on the restoring
-    step (:func:`restore_into` does both)."""
-    man = load_manifest(path)
+def _reassemble(man, group_entries, where):
+    """Merge per-ownership-group entry dicts into LOGICAL host pytrees
+    ``(params, opt_state, aux)`` — ZeRO ``(dp, chunk)`` rows concatenated,
+    un-padded against the manifest's logical shapes and reshaped, stage
+    groups merged.  ``group_entries`` yields ``(group_name, entries)``
+    pairs; the two producers are :func:`_iter_shards` (checkpoint files,
+    via :func:`load_sharded`) and a live :func:`snapshot` job's
+    ``groups`` dict (:func:`reassemble` — the no-disk live-resize path),
+    so both routes share ONE copy of the layout math by construction.
+    ``where`` names the source in errors."""
     params, aux = {}, {}
     flat_leaves = {}                    # (name, i) -> leaf | {row: chunk}
     zparams = {}                        # name -> {row: chunk} (ZeRO-3)
-    for meta, entries in _iter_shards(path, man, verify=verify):
-        m = _ZERO_RE.match(meta["group"])
+    for group, entries in group_entries:
+        m = _ZERO_RE.match(group)
         zrow = int(m.group(2)) if m else None
         for ename, arr in entries.items():
             kind, rest = ename.split(":", 1)
@@ -504,7 +505,7 @@ def load_sharded(path, verify=True):
         if sorted(rows) != list(range(len(rows))):
             raise MXNetError(
                 "checkpoint %s: ZeRO-3 parameter rows of %s are not "
-                "contiguous (%s)" % (path, n, sorted(rows)))
+                "contiguous (%s)" % (where, n, sorted(rows)))
         shape = tuple(man["params"][n]["shape"])
         size = 1
         for d in shape:
@@ -513,7 +514,7 @@ def load_sharded(path, verify=True):
                                 for j in sorted(rows)])
         params[n] = flat[:size].reshape(shape)
     if man["opt_state"] is None:
-        return man, params, None, aux
+        return params, None, aux
     opt_state = {}
     for n, count in man["opt_state"].items():
         leaves = []
@@ -526,17 +527,48 @@ def load_sharded(path, verify=True):
             if leaf is None:
                 raise MXNetError(
                     "checkpoint %s: optimizer-state leaf %d of %s is "
-                    "absent from every shard" % (path, i, n))
+                    "absent from every shard" % (where, i, n))
             if isinstance(leaf, dict):
                 rows = [leaf[j] for j in sorted(leaf)]
                 if sorted(leaf) != list(range(len(rows))):
                     raise MXNetError(
                         "checkpoint %s: ZeRO rows of %s[%d] are not "
-                        "contiguous (%s)" % (path, n, i, sorted(leaf)))
+                        "contiguous (%s)" % (where, n, i, sorted(leaf)))
                 flat = _np.concatenate([r.reshape(-1) for r in rows])
                 leaf = flat[:size].reshape(shape)
             leaves.append(leaf)
         opt_state[n] = tuple(leaves)
+    return params, opt_state, aux
+
+
+def load_sharded(path, verify=True):
+    """Load a sharded checkpoint into LOGICAL host pytrees:
+    ``(manifest, params, opt_state, aux)`` with every tensor reassembled
+    to its logical (unsharded, unpadded) shape — ZeRO ``(dp, chunk)``
+    rows concatenated and reshaped, stage files merged.  This is the
+    topology-free half of any-topology restore; placement back onto a
+    (possibly different) mesh is ``place_checkpoint`` on the restoring
+    step (:func:`restore_into` does both)."""
+    man = load_manifest(path)
+    pairs = ((meta["group"], entries)
+             for meta, entries in _iter_shards(path, man, verify=verify))
+    params, opt_state, aux = _reassemble(man, pairs, path)
+    return man, params, opt_state, aux
+
+
+def reassemble(job):
+    """LOGICAL host pytrees from an in-memory :func:`snapshot` job — a
+    save + :func:`load_sharded` round trip without the disk in between.
+    ``snapshot`` → ``reassemble`` → :func:`restore_loaded` re-shards a
+    LIVE training state onto a new topology (the live-resize path,
+    parallel/resize.py): the job's ``groups`` dict is byte-for-byte what
+    the shard writer would serialise, reassembled here through the SAME
+    group math the file loader uses, so the re-shard is bitwise equal to
+    the checkpoint-restore path by construction.  Returns ``(manifest,
+    params, opt_state, aux)``."""
+    man = job["manifest"]
+    params, opt_state, aux = _reassemble(man, sorted(job["groups"].items()),
+                                         "<live snapshot>")
     return man, params, opt_state, aux
 
 

@@ -293,6 +293,17 @@ def snapshot(reason, exc=None, extra=None):
             bundle["collective_ledger"] = _san.ledger_tail()
     except Exception:   # diagnostics must never add a second failure
         pass
+    try:
+        from .parallel import resize as _resize
+        rz = _resize.stats()
+        if rz["history"]:
+            # live-resize trajectory (elasticity v3): which membership
+            # transitions this process survived, when, and at what cost —
+            # a post-mortem of an elastic fleet needs the world-size
+            # history next to the collective ledger it rebased
+            bundle["resize"] = rz
+    except Exception:   # diagnostics must never add a second failure
+        pass
     if exc is not None:
         bundle["exception"] = {
             "type": type(exc).__name__,

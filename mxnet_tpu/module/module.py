@@ -524,7 +524,17 @@ def _fused_fit_key_fields(opt, policy):
         "pp_schedule": get_env("MXNET_PP_SCHEDULE", None),
         "pp_interleave": get_env("MXNET_PP_INTERLEAVE", None, typ=int),
         "zero": get_env("MXNET_ZERO", None, typ=int),
+        # a live resize (parallel/resize.py) rewrites the MXTPU world
+        # contract mid-process: a step traced for the old world must
+        # never be reused at the new size, even if every other lever
+        # matches (apply_resize also drops the cache outright)
+        "world": _ckpt_world(),
     }
+
+
+def _ckpt_world():
+    from ..checkpoint import _world
+    return _world()
 
 
 class _FusedFit(object):
@@ -713,6 +723,48 @@ class _FusedFit(object):
         return checkpointer.save(self._ts, self._params, self._state,
                                  self._aux, epoch=epoch, nbatch=nbatch,
                                  extra=extra)
+
+    # --------------------------------------------------- live resize hooks
+    def export_state(self, epoch=0, nbatch=0):
+        """LOGICAL host export of the live training state —
+        ``checkpoint.snapshot`` + ``reassemble``, i.e. a save +
+        load_sharded round trip with no disk in between.  Returns
+        ``(man, params, opt_state, aux)``; the manifest carries the
+        exact update count, loss-scale automaton, topology, and the
+        ``(epoch, nbatch)`` position stamped here.  The resize
+        controller calls this to quiesce state BEFORE tearing down the
+        old world (all device work is local, no peers involved)."""
+        from .. import checkpoint as _ckpt
+        return _ckpt.reassemble(_ckpt.snapshot(
+            self._ts, self._params, self._state, self._aux,
+            epoch=epoch, nbatch=nbatch))
+
+    def apply_resize(self, man, params, opt_state, aux):
+        """Rebuild this fused engine IN PLACE for the current (post-
+        transition) world and re-place the exported state onto the new
+        step — same object identity, so the fit loop's ``fast`` binding
+        keeps working across the seam.  Re-runs ``__init__`` with the
+        resume hook armed: the new TrainStep is built against the
+        rewritten MXTPU env contract and ``restore_loaded`` re-shards
+        params/optimizer state/loss scale with the exact update count —
+        the same code path as a checkpoint restore, minus the disk."""
+        mod = self._mod
+        # the old step's compiled program belongs to the old world
+        mod._fused_ts_cache = None
+        # skip get_params()'s sync-back from the OLD step inside
+        # __init__ — the restore below overwrites every value it would
+        # export, and the executors only contribute shapes here
+        mod._active_fused = None
+        mod._params_dirty = False
+        mod._ckpt_resume = {"path": "<live resize>", "man": man,
+                            "params": params, "opt_state": opt_state,
+                            "aux": aux}
+        try:
+            self.__init__(mod, self._policy)
+        finally:
+            # __init__ consumes the hook on success; a failed rebuild
+            # must not leak it into an unrelated later fit
+            mod._ckpt_resume = None
 
     def _updater(self):
         mod = self._mod

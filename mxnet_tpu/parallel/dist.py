@@ -25,6 +25,7 @@ hot-state model is intentionally replaced by checkpointing).
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 
@@ -33,6 +34,129 @@ import numpy as _np
 from ..base import MXNetError, get_env
 
 _initialized = False
+
+
+def _connect(coord, nproc, pid):
+    """Bring up the coordination service/client for the (coord, nproc,
+    pid) world, with bounded retry-with-backoff around the connect.
+
+    A rank that boots a few seconds before the coordinator used to fail
+    the whole world on one transient connect error; a live resize
+    (parallel/resize.py) re-runs this path on every membership change,
+    which makes the race hot.  ``MXNET_DIST_CONNECT_RETRIES`` attempts
+    (default 3), sleeping ``MXNET_DIST_CONNECT_BACKOFF_SEC`` (default
+    0.5, doubling) between them; the curated error names the attempt
+    count and the last cause.  A double-initialize programming error is
+    never retried — backoff cannot fix it.
+
+    Two entry modes, picked by backend state:
+
+    - backend NOT yet created: the standard ``jax.distributed.initialize``
+      — the device plane spans the world (multi-process ``jax.devices()``,
+      gloo collectives on CPU);
+    - backend ALREADY created (a live resize re-init, or a
+      coordination-only world that touched devices first):
+      ``jax.distributed.initialize`` refuses to run, so the coordination
+      service/client is brought up directly through jax's internal
+      ``global_state.initialize`` — the backend stays single-process
+      while barriers/KV/membership ride the service.  This is the ONE
+      sanctioned use of that internal (same ownership rule as
+      ``coordination_client``)."""
+    import jax
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # The env var alone can be ignored when an accelerator plugin is
+        # installed; pin the platform programmatically (must precede any
+        # backend-initialising call).  The CPU backend also needs an
+        # explicit cross-process collectives implementation (TPU rides
+        # ICI natively).
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
+    from jax._src import xla_bridge as _xb
+    coordination_only = _xb.backends_are_initialized()
+    attempts = max(1, get_env("MXNET_DIST_CONNECT_RETRIES", 3, typ=int))
+    backoff = get_env("MXNET_DIST_CONNECT_BACKOFF_SEC", 0.5, typ=float)
+    last = None
+    for attempt in range(1, attempts + 1):
+        try:
+            if coordination_only:
+                _coordination_connect(coord, nproc, pid)
+            else:
+                jax.distributed.initialize(coordinator_address=coord,
+                                           num_processes=nproc,
+                                           process_id=pid)
+            return
+        except Exception as e:   # noqa: BLE001 — classified below
+            if "should only be called once" in str(e):
+                raise           # double-init: a caller bug, not transient
+            last = e
+            if attempt < attempts:
+                import time as _time
+                _time.sleep(backoff * (2 ** (attempt - 1)))
+    raise MXNetError(
+        "init_process_group: cannot connect to the coordination service "
+        "at %s after %d attempt(s) (world %d, rank %d): %s — transient "
+        "startup races retry with backoff (MXNET_DIST_CONNECT_RETRIES / "
+        "MXNET_DIST_CONNECT_BACKOFF_SEC); a persistent failure means the "
+        "coordinator address is wrong or rank 0 died during startup"
+        % (coord, attempts, nproc, pid, last))
+
+
+def _nonfatal_peer_error(status):
+    """Replacement for jax's default distributed-client error callback.
+
+    The default (xla client.h) TERMINATES THE PROCESS when the
+    coordination service reports a peer failure or a heartbeat lapses —
+    exactly the signal a live resize (parallel/resize.py) handles in
+    Python: the membership gate times out, the supervisor publishes a
+    shrink plan, and the survivor transitions IN PLACE.  An abandoned
+    generation's zombie client (see ``_zombies``) eventually polls the
+    dead peer's heartbeat error too; letting it abort the survivor would
+    turn every recoverable membership change into a fleet loss.  So:
+    log, remember, never terminate."""
+    global _peer_error
+    _peer_error = str(status)
+    logging.getLogger(__name__).warning(
+        "coordination service reported a peer error (world membership "
+        "change?): %s — continuing; the membership gate/elastic "
+        "supervisor decides what happens next", status)
+
+
+_peer_error = None
+
+
+def _coordination_connect(coord, nproc, pid):
+    """Coordination-ONLY world bring-up (backend already initialized):
+    the service on rank 0 plus a client per rank, wired into jax's
+    ``global_state`` so ``coordination_client()`` and jax's own users
+    find them.  Mirrors ``jax._src.distributed.State.initialize`` minus
+    backend coupling, with one deliberate difference: the client gets
+    :func:`_nonfatal_peer_error` instead of the default
+    terminate-the-process callback, and never shuts down on destruction
+    (a zombie generation's destructor must not run a blocking handshake
+    with a dead world)."""
+    from jax._src import distributed as _jdist
+    from jax._src.lib import xla_extension as _xe
+    state = _jdist.global_state
+    if state.client is not None:
+        # same message class as jax.distributed.initialize — _connect
+        # classifies double-init as a caller bug, never retried
+        raise RuntimeError("jax.distributed.initialize should only be "
+                           "called once")
+    if pid == 0 and state.service is None:
+        bind = "[::]:%s" % coord.rsplit(":", 1)[1]
+        state.service = _xe.get_distributed_runtime_service(bind, nproc)
+    client = _xe.get_distributed_runtime_client(
+        coord, pid, missed_heartbeat_callback=_nonfatal_peer_error,
+        shutdown_on_destruction=False)
+    client.connect()
+    state.client = client
+    state.process_id = pid
+    state.num_processes = nproc
+    if hasattr(state, "coordinator_address"):
+        state.coordinator_address = coord
 
 
 def init_process_group():
@@ -44,20 +168,7 @@ def init_process_group():
     nproc = get_env("MXTPU_NUM_PROCESSES", typ=int)
     pid = get_env("MXTPU_PROCESS_ID", typ=int)
     if coord and nproc and nproc > 1:
-        import jax
-        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-            # The env var alone can be ignored when an accelerator plugin is
-            # installed; pin the platform programmatically (must precede any
-            # backend-initialising call).  The CPU backend also needs an
-            # explicit cross-process collectives implementation (TPU rides
-            # ICI natively).
-            try:
-                jax.config.update("jax_platforms", "cpu")
-                jax.config.update("jax_cpu_collectives_implementation", "gloo")
-            except Exception:
-                pass
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=nproc, process_id=pid or 0)
+        _connect(coord, nproc, pid or 0)
     _initialized = True
     from .. import telemetry as _tel
     if _tel._enabled:
@@ -65,6 +176,61 @@ def init_process_group():
         # endpoint can label this process without re-deriving the contract
         _tel.gauge("dist_world_size", nproc if (coord and nproc) else 1)
         _tel.gauge("dist_rank", pid or 0)
+
+
+# coordination clients/services of torn-down worlds, kept referenced ON
+# PURPOSE: their C++ destructors run the graceful shutdown handshake
+# (blocking RPCs a world that lost a member can never complete), so
+# dropping the last reference inside a resize would hang the survivor
+# inside a destructor.  Bounded by the number of resizes in one process
+# lifetime; each entry is two small RPC endpoints, not device state.
+_zombies = []
+
+
+def shutdown_process_group(graceful=False):
+    """Tear down the distributed runtime so :func:`init_process_group`
+    can bring up a NEW world (the live-resize transition).
+
+    ``graceful=True`` runs jax's full shutdown handshake — every peer
+    must still be alive to meet the shutdown barrier.  ``graceful=False``
+    (the resize default) ABANDONS the old client/service without the
+    handshake: the old world has lost a member by definition, and the
+    handshake would block on the dead rank forever.  Abandoned endpoints
+    are stashed in ``_zombies`` (see above) rather than dropped.
+
+    Also resets this module's world-derived state — the worker mesh and
+    the fused allreduce programs hold the OLD world's device topology —
+    and re-arms the idempotence latch so the next collective re-reads
+    the (rewritten) MXTPU env contract."""
+    global _initialized, _worker_mesh
+    state = None
+    try:
+        from jax._src import distributed as _jdist
+        state = _jdist.global_state
+    except Exception:            # internal layout moved
+        pass
+    if state is not None and (getattr(state, "client", None) is not None
+                              or getattr(state, "service", None) is not None):
+        if graceful:
+            import jax
+            jax.distributed.shutdown()
+        else:
+            _zombies.append((state.client, state.service,
+                             getattr(state, "preemption_sync_manager",
+                                     None)))
+            state.client = None
+            state.service = None
+            if hasattr(state, "preemption_sync_manager"):
+                state.preemption_sync_manager = None
+            if hasattr(state, "coordinator_address"):
+                state.coordinator_address = None
+            if hasattr(state, "process_id"):
+                state.process_id = 0
+            if hasattr(state, "num_processes"):
+                state.num_processes = None
+    _initialized = False
+    _worker_mesh = None
+    _sum_cache.clear()
 
 
 def rank():
@@ -126,6 +292,87 @@ def coordination_client():
         return None
 
 
+def peer_world():
+    """``(world, rank)`` of this process's coordination-service peer
+    group.  The device backend's own world when it is multi-process;
+    otherwise — the coordination-only coupling a live resize runs in,
+    where the backend stays single-process but the service still couples
+    the ranks — the MXTPU env contract, provided a coordination client is
+    actually connected.  Standalone: ``(1, 0)``."""
+    init_process_group()
+    import jax
+    if jax.process_count() > 1:
+        return jax.process_count(), jax.process_index()
+    if coordination_client() is not None:
+        from .. import checkpoint as _ckpt
+        return _ckpt._world(), _ckpt._rank()
+    return 1, 0
+
+
+def membership_barrier(name, timeout_ms=30000):
+    """Bounded liveness/membership gate over the coordination service —
+    a barrier EXPECTED to fail when the world changed.  True when every
+    peer arrived within ``timeout_ms``; False on timeout or service
+    error (a missing peer, a dead coordinator).  Standalone (no service):
+    trivially True.
+
+    Unlike :func:`coordination_barrier` this skips mxsan's hash-chain
+    exchange: the exchange would block on the dead peer's payload and
+    record a divergence violation before the probe could report — a
+    probe whose JOB is to observe membership loss must not trip the
+    checker that assumes membership is fixed.  The dispatch still lands
+    in the collective ledger (``device=False``) so a post-mortem names
+    the gate in flight.  Service barrier ids are single-use: callers
+    suffix a generation/sequence (the ``health_check`` idiom)."""
+    init_process_group()
+    import jax
+    client = coordination_client()
+    if client is None:
+        if jax.process_count() <= 1:
+            return True
+        # multi-process device world but no client lookup: probing via a
+        # device collective could hang forever on the very peer loss the
+        # probe exists to detect — fail loudly instead
+        raise MXNetError(
+            "membership_barrier: jax's coordination-service client is "
+            "unavailable in this jax version — membership cannot be "
+            "probed without a device collective (fix "
+            "dist.coordination_client)")
+    from .. import sanitize as _san
+    with _san.collective_dispatch("membership_barrier", name=name,
+                                  device=False):
+        try:
+            client.wait_at_barrier(name, timeout_ms)
+            return True
+        except Exception:
+            return False
+
+
+def kv_set(key, value):
+    """Publish ``value`` (str) under ``key`` on the coordination service
+    (single writer per key within one service lifetime — the live-resize
+    state hand-off publishes under a generation-suffixed key)."""
+    init_process_group()
+    client = coordination_client()
+    if client is None:
+        raise MXNetError(
+            "kv_set: no coordination-service client (single-process "
+            "world, or a jax upgrade moved the internal lookup)")
+    client.key_value_set(key, value)
+
+
+def kv_get(key, timeout_ms=600000):
+    """Blocking read of ``key`` from the coordination service (bounded;
+    raises on timeout).  The receive side of :func:`kv_set`."""
+    init_process_group()
+    client = coordination_client()
+    if client is None:
+        raise MXNetError(
+            "kv_get: no coordination-service client (single-process "
+            "world, or a jax upgrade moved the internal lookup)")
+    return client.blocking_key_value_get(key, timeout_ms)
+
+
 def coordination_barrier(name, timeout_ms=600000):
     """Process barrier over the coordination SERVICE (key-value RPC, no
     device collectives).  ``barrier``/``sync_global_devices`` launches a
@@ -136,9 +383,12 @@ def coordination_barrier(name, timeout_ms=600000):
     coordination-service lifetime."""
     init_process_group()
     import jax
-    if jax.process_count() <= 1:
-        return
     client = coordination_client()
+    if jax.process_count() <= 1 and client is None:
+        # truly standalone.  A single-process BACKEND with a live client
+        # is the coordination-only world a live resize runs in — those
+        # ranks still meet each other here, through the service.
+        return
     from .. import sanitize as _san
     # device=False: the service barrier is thread-safe by design — the
     # checkpoint writer thread meeting its peers here is the sanctioned

@@ -25,6 +25,19 @@ state, loss scale and the exact update count, skips the already-consumed
 batches of the interrupted epoch, and re-shards onto the CURRENT topology
 (a respawn at a smaller world size / different MXNET_PP rebuilds the mesh
 and restores instead of refusing).
+
+Elastic v3 (docs/elastic.md "Live resize"): a membership change is a
+runtime TRANSITION, not a process lifecycle.  Under the tools/launch.py
+``--elastic`` supervisor (``MXNET_ELASTIC_PLAN``), :func:`fit_elastic`
+installs a :class:`parallel.resize.ResizeController` on the module: the
+fit loop gates each step on a bounded membership barrier, and on a world
+change the surviving ranks quiesce at the step boundary, tear down and
+re-initialize the distributed runtime at the new world size, and
+re-shard parameters/optimizer state/loss scale device-to-device through
+the checkpoint layout math — without touching disk and without dying.  A
+rank respawned by the supervisor JOINS the existing world: the state it
+resumes from is handed off over the coordination service's key-value
+store, not a file (see the join branch below).
 """
 from __future__ import annotations
 
@@ -47,68 +60,44 @@ _health_generation = [0]
 
 
 def health_check(timeout=30.0, name="health"):
-    """True when every process reaches a barrier within ``timeout`` seconds.
+    """True when every process reaches a coordination-service barrier
+    within ``timeout`` seconds.
 
     COLLECTIVE call: every process in the world must invoke it the same
     number of times (the generation suffix below is process-local, so an
     asymmetric call pattern desyncs barrier names — exactly like calling the
     reference's ps-lite Barrier from only one worker).
 
-    Replaces ps-lite heartbeat polling: on TPU a missing peer does not
-    heartbeat-timeout, it stalls the next collective — so health IS
-    "barriers still complete".  Runs the barrier on a daemon thread so a
-    dead world cannot hang the caller.
-
-    Caveat: a *timed-out* check leaves its barrier pending on the daemon
-    thread.  If the world was merely slow (not dead), the stale barrier could
-    otherwise satisfy a *later* check's barrier on peers and desync the
-    world; each check therefore uses a process-local generation suffix so a
-    stale pending barrier can never pair with a newer one.  Still treat
-    False as fatal and restart the world (the tools/launch.py
-    --max-restarts supervisor does exactly this).  A module-level lock
-    serialises checks within this process."""
+    Replaces ps-lite heartbeat polling: health IS "barriers still
+    complete".  The probe rides :func:`dist.membership_barrier` — a
+    coordination-service RPC with a service-side deadline, NO device
+    collective — so a dead world times out server-side and leaves
+    nothing pending: no probe thread, no leaked device barrier (the
+    daemon-thread design this replaced needed a THR002 suppression and a
+    runtime ``allow_thread_collective`` escape; both are gone), and the
+    generation suffix burns each barrier id so a timed-out probe can
+    never pair with a later one.  Treat False as fatal — restart or
+    live-resize the world (tools/launch.py --max-restarts/--elastic)."""
     from . import dist
-    ok = threading.Event()
-
     with _health_lock:
         _health_generation[0] += 1
         barrier_name = "%s-%d" % (name, _health_generation[0])
-
-        def _barrier():
-            from .. import sanitize as _san
-            # the ONE sanctioned off-main-thread device collective:
-            # bounded by the caller's join(timeout), generation-suffixed
-            # so a stale pending barrier can never pair with a newer one,
-            # and the caller treats a miss as fatal — declared to the
-            # mxsan collective checker the way planned syncs declare
-            # allow_sync.  Its static twin is the THR002 suppression on
-            # the dist.barrier call below.
-            with _san.allow_thread_collective(
-                    "health probe: bounded, generation-suffixed barrier"):
-                try:
-                    # mxlint: disable=THR002 bounded health probe by design — generation-suffixed id, caller join(timeout), False is fatal
-                    dist.barrier(barrier_name)
-                    ok.set()
-                except Exception:
-                    pass
-
-        t = threading.Thread(target=_barrier, daemon=True)
-        t.start()
-        t.join(timeout)
-        return ok.is_set()
+    return dist.membership_barrier(barrier_name,
+                                   timeout_ms=max(1, int(timeout * 1000)))
 
 
 def num_dead_node(node_id=0, timeout=30):
     """Reference API shape (kvstore.h:242): number of unreachable nodes.
 
     Binary on TPU: 0 when the world is healthy, else the number of peer
-    processes (any dead host fails the whole collective group)."""
-    import jax
+    processes (any dead host fails the whole collective group).  The
+    world is the coordination-service peer group (``dist.peer_world``),
+    so coordination-only worlds — the live-resize mode — probe too."""
     from . import dist
-    dist.init_process_group()
-    if jax.process_count() <= 1:
+    world, _ = dist.peer_world()
+    if world <= 1:
         return 0
-    return 0 if health_check(timeout=timeout) else jax.process_count() - 1
+    return 0 if health_check(timeout=timeout) else world - 1
 
 
 def is_recovery():
@@ -117,7 +106,11 @@ def is_recovery():
     return int(get_env("MXTPU_RESTART_COUNT", "0") or "0") > 0
 
 
-_EPOCH_RE = re.compile(r"-(\d{4})\.params$")
+# 4+ digits, not exactly 4: "%04d" WIDENS past epoch 9999, and an exact
+# match would silently hide every >= 5-digit checkpoint from
+# latest_checkpoint (resume would restart from an older epoch) — the
+# same off-by-a-width checkpoint.py's _STEP_RE (\d{8,}) already fixed
+_EPOCH_RE = re.compile(r"-(\d{4,})\.params$")
 
 # per-process fit_elastic call counter: the epoch-end barrier ids must be
 # unique per use within one coordination-service lifetime (all ranks call
@@ -254,11 +247,31 @@ def fit_elastic(module, train_data, prefix, num_epoch, eval_data=None,
     instead of refusing (docs/elastic.md has the matrix)."""
     from .. import callback as callback_mod
     from .. import checkpoint as _ckpt
+    from . import resize as _resize
     every = get_env("MXNET_CKPT_EVERY_N_STEPS", None, typ=int)
+    # live resize (elastic v3): under the --elastic supervisor
+    # (MXNET_ELASTIC_PLAN) a controller watches the world plan from
+    # inside the fit loop; a respawned rank is a JOIN — its resume state
+    # arrives over the coordination service from a survivor, newer than
+    # any checkpoint on disk, so the join branch preempts _resume_point
+    rz = _resize.controller()
+    join = rz.consume_join_state() if rz is not None else None
     begin = 0
     skip = 0
-    resume = _resume_point(prefix)
-    if resume is not None and resume[0] == "mono":
+    resume = None if join is not None else _resume_point(prefix)
+    if join is not None:
+        man, params, opt_st, aux = join
+        begin, skip = int(man["epoch"]), int(man["nbatch"]) + 1
+        fit_kwargs["arg_params"] = params
+        fit_kwargs["aux_params"] = aux
+        fit_kwargs["force_init"] = True
+        module._ckpt_resume = {"path": "<live-resize join>", "man": man,
+                               "params": params, "opt_state": opt_st,
+                               "aux": aux}
+        _LOG.info("fit_elastic: joining a live world at epoch %d, batch "
+                  "%d, step %d (plan generation %d)", begin, skip,
+                  man["step"], rz.gen)
+    elif resume is not None and resume[0] == "mono":
         # bind is needed before set_params; fit() would bind lazily, so
         # defer actual loading to arg_params via load_checkpoint
         from .. import model as model_mod
@@ -351,12 +364,24 @@ def fit_elastic(module, train_data, prefix, num_epoch, eval_data=None,
         batch_cbs = batch_cbs + [callback_mod.do_step_checkpoint(
             module, ckptr, every, resume_epoch=begin, nbatch_offset=skip)]
     data = _ResumeIter(train_data, skip) if skip else train_data
+    if rz is not None:
+        # the fit loop's per-batch hook (base_module) gates each step on
+        # the controller; installed for THIS fit only — a later fit
+        # without the supervisor must not keep probing a stale plan.
+        # The loop's nbatch counter restarts at 0 after a _ResumeIter
+        # skip, so the controller needs the offset to stamp TRUE batch
+        # positions into hand-off manifests
+        rz.resume_epoch = begin
+        rz.nbatch_offset = skip
+        module._resize_controller = rz
     try:
         module.fit(data, eval_data=eval_data, num_epoch=num_epoch,
                    begin_epoch=begin, epoch_end_callback=callbacks,
                    batch_end_callback=batch_cbs or None,
                    **fit_kwargs)
     finally:
+        if rz is not None:
+            module._resize_controller = None
         if ckptr is not None:
             # durability barrier: queued sharded saves land (or their
             # failure surfaces) before fit_elastic returns

@@ -1,0 +1,475 @@
+# Licensed to the Apache Software Foundation (ASF) under one
+# or more contributor license agreements.  See the NOTICE file
+# distributed with this work for additional information
+# regarding copyright ownership.  The ASF licenses this file
+# to you under the Apache License, Version 2.0 (the
+# "License"); you may not use this file except in compliance
+# with the License.  You may obtain a copy of the License at
+#
+#   http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing,
+# software distributed under the License is distributed on an
+# "AS IS" BASIS, WITHOUT WARRANTIES OR CONDITIONS OF ANY
+# KIND, either express or implied.  See the License for the
+# specific language governing permissions and limitations
+# under the License.
+"""Live world resize — elasticity v3 (docs/elastic.md "Live resize").
+
+Elastic v1/v2 recover from membership loss by killing the whole world
+and respawning it from the newest checkpoint: correct, but every
+transition costs a full process restart, a JIT re-trace, and the steps
+since the last save.  v3 makes a membership change a RUNTIME TRANSITION
+inside the surviving processes:
+
+1. **Detect** — each rank runs a bounded membership gate
+   (:func:`dist.membership_barrier`) at step boundaries.  A missing peer
+   surfaces as a gate timeout; a deliberate change (a re-added rank)
+   arrives as a generation bump of the WORLD PLAN file the ``--elastic``
+   supervisor maintains (``MXNET_ELASTIC_PLAN``).
+2. **Quiesce** — the transition runs between two optimizer steps, never
+   inside one, so there is no in-flight collective to unwind.
+3. **Re-init** — the old distributed runtime is torn down without a
+   peer handshake (the peer is gone), the MXTPU env contract is
+   re-pointed at the plan's new coordinator, and the runtime comes back
+   at the new world size.
+4. **Re-shard** — the live training state is host-exported through the
+   checkpoint layout math (``checkpoint.snapshot`` → ``reassemble``) and
+   re-placed onto the new mesh with ``checkpoint.restore_loaded`` —
+   device-to-device, no disk, bitwise equal to a save/restore round trip
+   at the same topology BY CONSTRUCTION (same code on both paths).
+5. **Resume** — the fused fit rebuilds in place
+   (``_FusedFit.apply_resize``) with the exact update count; a rank the
+   supervisor re-adds joins mid-epoch, its resume state handed over by a
+   survivor through the coordination-service key-value store.
+
+The plan file is the supervisor→worker protocol (single host; written
+atomically, polled by one ``os.stat`` per gated step)::
+
+    {"gen": 3, "world": 2, "coordinator": "localhost:41207",
+     "assign": {"0": 0, "1": 1}, "join": ["1"]}
+
+``assign`` maps the immutable launch SLOT (``MXTPU_SLOT``) to the rank a
+process holds in generation ``gen`` — ranks are reassigned across
+generations (a survivor may become rank 0 when the old rank 0 died) but
+a slot never changes.  Every generation gets a FRESH coordinator
+address: the old coordination service dies with its world and barrier
+ids are single-use, so reusing a port would couple two generations'
+RPC state.  ``join`` names the slots entering this generation whose
+state must be handed over.
+
+Verification stack across the seam: mxsan's collective hash chain is
+rebased on every member of the new world
+(:func:`sanitize.collective_rebase`) so survivor and joiner histories
+never falsely diverge, and the membership gates themselves bypass the
+chain exchange (they are the one collective EXPECTED to fail).  The
+PR 13 collective ledger stays armed throughout — a resize under
+``MXNET_SAN=collective:raise`` must be violation-free.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import threading
+import time
+
+from ..base import MXNetError, atomic_write, get_env
+
+__all__ = ["ResizeController", "controller", "read_plan", "write_plan",
+           "reshard_train_step", "stats"]
+
+_LOG = logging.getLogger(__name__)
+
+# process-global resize bookkeeping: diagnostics.snapshot() folds this
+# into the bundle (tools/diagnose.py renders it) and tests assert on it;
+# survives controller churn across multiple elastic fits
+_lock = threading.Lock()
+_state = {"resizes": 0, "lost_steps": 0, "world": None,
+          "history": [], "last": None}
+
+
+def stats():
+    """Copy of the process-global resize bookkeeping — ``resizes``
+    (completed membership transitions), ``lost_steps`` (optimizer steps
+    rolled back across all of them; 0 for in-place transitions),
+    ``world`` (size after the last transition), ``history`` (world-size
+    trajectory, one event per transition) and ``last`` (the newest
+    event).  Empty-history processes report zeros; diagnostics only
+    includes the section when a transition actually happened."""
+    with _lock:
+        return {"resizes": _state["resizes"],
+                "lost_steps": _state["lost_steps"],
+                "world": _state["world"],
+                "history": [dict(h) for h in _state["history"]],
+                "last": dict(_state["last"]) if _state["last"] else None}
+
+
+def _record(event):
+    with _lock:
+        _state["resizes"] += 1
+        _state["lost_steps"] += int(event.get("lost_steps", 0))
+        _state["world"] = event.get("world")
+        _state["history"].append(event)
+        _state["last"] = event
+
+
+def _reset_stats():
+    # test seam only
+    with _lock:
+        _state.update(resizes=0, lost_steps=0, world=None,
+                      history=[], last=None)
+
+
+# ---------------------------------------------------------------- plan file
+def write_plan(path, gen, world, coordinator, assign, join=()):
+    """Atomically publish world-plan generation ``gen`` (supervisor
+    side, tools/launch.py ``--elastic``).  ``assign`` maps launch slot →
+    rank; ``join`` lists slots entering this generation (their state is
+    handed over by a survivor).  Write-to-temp + rename: a worker's poll
+    never observes a torn plan."""
+    plan = {"gen": int(gen), "world": int(world),
+            "coordinator": str(coordinator),
+            "assign": {str(k): int(v) for k, v in dict(assign).items()},
+            "join": [str(s) for s in join]}
+    with atomic_write(path) as f:
+        f.write(json.dumps(plan, sort_keys=True).encode())
+    return plan
+
+
+def read_plan(path):
+    """Parse a world-plan file (see :func:`write_plan`)."""
+    with open(path, "rb") as f:
+        plan = json.loads(f.read().decode())
+    for field in ("gen", "world", "coordinator", "assign"):
+        if field not in plan:
+            raise MXNetError("world plan %s: missing field %r"
+                             % (path, field))
+    return plan
+
+
+# ------------------------------------------------------------- state codec
+# The join hand-off serialises the LOGICAL host state (what reassemble
+# returns) through the coordination-service KV store.  ndarray's .params
+# byte format carries the arrays (one codec repo-wide), base64 keeps the
+# value within the string-typed KV API.  Sized for drill/test models; a
+# production fleet would stage multi-GB state through storage and pass a
+# location here instead.
+
+def _encode_state(man, params, opt_state, aux):
+    from .. import ndarray as nd
+
+    def b64(arrays):
+        return base64.b64encode(nd.serialize_arrays(arrays)).decode("ascii")
+
+    payload = {"manifest": man, "params": b64(params), "aux": b64(aux)}
+    if opt_state is not None:
+        flat = {"%s:%d" % (n, i): leaf
+                for n, leaves in opt_state.items()
+                for i, leaf in enumerate(leaves)}
+        payload["opt"] = b64(flat)
+    return json.dumps(payload)
+
+
+def _decode_state(blob):
+    from .. import ndarray as nd
+
+    def unb64(field):
+        return nd.deserialize_arrays(base64.b64decode(payload[field]))
+
+    payload = json.loads(blob)
+    man = payload["manifest"]
+    params = unb64("params")
+    aux = unb64("aux")
+    opt_state = None
+    if man.get("opt_state") is not None:
+        flat = unb64("opt")
+        opt_state = {n: [flat["%s:%d" % (n, i)] for i in range(count)]
+                     for n, count in man["opt_state"].items()}
+    return man, params, opt_state, aux
+
+
+def _state_key(gen):
+    return "mxtpu-resize-state-g%d" % int(gen)
+
+
+# ---------------------------------------------------------------- re-shard
+def reshard_train_step(old_ts, params, opt_state, aux, new_ts, device=None):
+    """Device-to-device re-shard of a LIVE training state onto a new
+    step/topology — ``old_ts.export_host`` (the checkpoint snapshot +
+    reassemble math, no disk) then ``checkpoint.restore_loaded`` onto
+    ``new_ts``.  Returns ``(params, opt_state, aux, manifest)`` placed
+    for ``new_ts``; ``new_ts.num_update`` and its loss-scale automaton
+    are restored from the manifest.  Bitwise equal to writing a sharded
+    checkpoint from ``old_ts`` and loading it into ``new_ts`` — both
+    routes are the same functions (test_resize holds this against the
+    test_checkpoint matrix)."""
+    from .. import checkpoint as _ckpt
+    man, p, s, a = old_ts.export_host(params, opt_state, aux)
+    return _ckpt.restore_loaded(new_ts, man, p, s, a, device=device,
+                                where="<live resize>")
+
+
+# -------------------------------------------------------------- controller
+def controller():
+    """A :class:`ResizeController` when this process runs under the
+    ``--elastic`` supervisor (``MXNET_ELASTIC_PLAN`` points at the world
+    plan), else None — fit_elastic installs it on the module for the
+    duration of one fit."""
+    path = get_env("MXNET_ELASTIC_PLAN")
+    if not path:
+        return None
+    return ResizeController(path)
+
+
+class ResizeController(object):
+    """Per-fit driver of live membership transitions.
+
+    The fit loop calls :meth:`step_gate` after every completed batch;
+    the gate is one ``os.stat`` of the plan file on the cheap path, plus
+    a bounded membership barrier every ``MXNET_RESIZE_GATE_EVERY`` steps
+    when the world is coupled.  A gate timeout (peer died) or a plan
+    generation bump (supervisor re-added a rank) triggers
+    :meth:`_transition`, which never returns control to the loop until
+    the process is training at the new world size — the loop itself
+    stays on the same iterator, same epoch, same batch counter.
+    """
+
+    def __init__(self, plan_path):
+        self.plan_path = plan_path
+        self.plan = read_plan(plan_path)
+        self.gen = int(self.plan["gen"])
+        # immutable launch identity; the CURRENT rank is assign[slot]
+        # and changes across generations
+        self.slot = str(get_env("MXTPU_SLOT", get_env("MXTPU_PROCESS_ID",
+                                                      "0")))
+        self._gate_every = max(1, get_env("MXNET_RESIZE_GATE_EVERY", 1,
+                                          typ=int))
+        self._gate_sec = get_env("MXNET_RESIZE_GATE_SEC", 30.0, typ=float)
+        self._seq = 0                 # gates since the last transition
+        self._mtime = None            # (mtime_ns, size) of the parsed plan
+        self._warned_slow_path = False
+        # position of THIS process's iterator when the fit resumed
+        # mid-epoch (fit_elastic sets these): the loop's nbatch counter
+        # restarts at 0 after a _ResumeIter skip, so the TRUE in-epoch
+        # batch index a hand-off manifest must carry is
+        # nbatch + offset while still inside the resumed epoch
+        self.resume_epoch = 0
+        self.nbatch_offset = 0
+        try:
+            st = os.stat(plan_path)
+            self._mtime = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------- polling
+    def _poll(self):
+        """One ``os.stat`` of the plan file; parse only when it changed.
+        Returns a NEWER-generation plan dict, or None."""
+        try:
+            st = os.stat(self.plan_path)
+        except OSError:
+            return None
+        sig = (st.st_mtime_ns, st.st_size)
+        if sig == self._mtime:
+            return None
+        self._mtime = sig
+        try:
+            plan = read_plan(self.plan_path)
+        except (OSError, ValueError, MXNetError):
+            # the write is atomic, but the file can be deleted under us
+            return None
+        if int(plan["gen"]) > self.gen:
+            return plan
+        self.plan = plan
+        return None
+
+    def _await_plan(self, timeout):
+        """After a failed membership gate: wait (bounded) for the
+        supervisor's post-mortem plan.  None when nothing newer arrives
+        — the gate failure was spurious (a slow peer, not a dead one)
+        and every rank deterministically resumes at the next gate."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            plan = self._poll()
+            if plan is not None:
+                return plan
+            time.sleep(0.1)
+        return self._poll()
+
+    # ---------------------------------------------------------------- join
+    def consume_join_state(self):
+        """On a rank the supervisor respawned INTO a live world
+        (``MXTPU_ELASTIC_JOIN=1``): connect to the generation's
+        coordination service and fetch the resume state a survivor
+        published — ``(man, params, opt_state, aux)``, newer than any
+        checkpoint on disk.  None on ordinary (non-join) starts."""
+        if str(get_env("MXTPU_ELASTIC_JOIN", "0")) != "1":
+            return None
+        from .. import sanitize as _san
+        from .. import telemetry as _tel
+        from . import dist
+        t0 = time.monotonic()
+        dist.init_process_group()
+        # the joiner's collective history begins at the seam, exactly
+        # like the survivors' rebased chains
+        _san.collective_rebase("resize-g%d" % self.gen)
+        timeout = get_env("MXNET_RESIZE_STATE_TIMEOUT_SEC", 300.0,
+                          typ=float)
+        blob = dist.kv_get(_state_key(self.gen),
+                           timeout_ms=max(1, int(timeout * 1000)))
+        man, params, opt_state, aux = _decode_state(blob)
+        self.resume_epoch = int(man["epoch"])
+        self.nbatch_offset = int(man["nbatch"]) + 1
+        self._seq = 0
+        seconds = time.monotonic() - t0
+        world = int(self.plan["world"])
+        _record({"kind": "join", "gen": self.gen, "world": world,
+                 "from_world": None, "epoch": int(man["epoch"]),
+                 "nbatch": int(man["nbatch"]), "step": int(man["step"]),
+                 "seconds": round(seconds, 3), "lost_steps": 0,
+                 "time": time.time()})
+        _tel.counter("elastic_resizes")
+        _tel.counter("resize_lost_steps", 0)
+        _tel.gauge("resize_seconds", seconds)
+        _LOG.info("live resize: joined generation %d as rank %d of %d "
+                  "(%.2fs, step %d)", self.gen,
+                  int(self.plan["assign"][self.slot]), world, seconds,
+                  int(man["step"]))
+        return man, params, opt_state, aux
+
+    # ---------------------------------------------------------------- gate
+    def step_gate(self, fast, epoch, nbatch):
+        """Membership gate at a step boundary (called by the fit loop
+        after batch ``nbatch`` of ``epoch`` completed).  True when a
+        transition ran — the caller's ``fast`` object has been rebuilt
+        in place for the new world."""
+        self._seq += 1
+        if self._seq % self._gate_every:
+            return False
+        if fast is None:
+            # the general (non-fused) fit path has no exportable
+            # TrainStep: those fits resize by supervisor respawn, v1/v2
+            # style, never in place
+            if not self._warned_slow_path:
+                self._warned_slow_path = True
+                _LOG.warning("live resize: fused fit path inactive — "
+                             "membership gates are skipped (general-path "
+                             "fits resize by respawn only)")
+            return False
+        plan = self._poll()
+        # a SHRINK plan means a peer is dead: skip the gate (it could
+        # only time out waiting for the corpse) and transition now.  The
+        # peers that have not seen the plan yet reach the same point via
+        # their own gate timeout — nobody trains an extra step
+        shrink = plan is not None and int(plan["world"]) < int(
+            self.plan["world"])
+        if int(self.plan["world"]) > 1 and not shrink:
+            from . import dist
+            ok = dist.membership_barrier(
+                "resize-gate-g%d-s%d" % (self.gen, self._seq),
+                timeout_ms=max(1, int(self._gate_sec * 1000)))
+            if ok:
+                if plan is None:
+                    # the gate orders this re-poll after any peer's plan
+                    # sighting (write < peer stat < gate < this stat, one
+                    # host) — a GROW plan is adopted by every member at
+                    # the SAME step boundary, never one step apart
+                    plan = self._poll()
+            else:
+                # a peer missed the gate — the coordination service
+                # fails the barrier for EVERY participant at the shared
+                # deadline, so all survivors fall through here together
+                # and wait for the supervisor's post-mortem plan
+                if plan is None:
+                    plan = self._await_plan(self._gate_sec)
+                if plan is None:
+                    _LOG.warning(
+                        "live resize: membership gate g%d-s%d failed but "
+                        "no newer world plan arrived within %.0fs — "
+                        "treating as a slow peer and continuing",
+                        self.gen, self._seq, self._gate_sec)
+                    return False
+        if plan is None:
+            return False
+        self._transition(plan, fast, epoch, nbatch)
+        return True
+
+    # ---------------------------------------------------------- transition
+    def _transition(self, plan, fast, epoch, nbatch):
+        """Quiesced world transition: export → teardown → re-init →
+        rebase → hand-off → in-place rebuild.  Runs at a step boundary
+        on every member of the NEW world that was also in the old one
+        (joiners run :meth:`consume_join_state` instead)."""
+        from .. import sanitize as _san
+        from .. import telemetry as _tel
+        from . import dist
+        t0 = time.monotonic()
+        gen = int(plan["gen"])
+        old_world = int(self.plan["world"])
+        new_world = int(plan["world"])
+        assign = plan["assign"]
+        join = set(plan.get("join") or ())
+        if self.slot not in assign:
+            raise MXNetError(
+                "live resize: world plan generation %d does not assign a "
+                "rank to slot %s — this process was removed from the "
+                "world (supervisor bug: v3 plans only drop DEAD slots)"
+                % (gen, self.slot))
+        my_rank = int(assign[self.slot])
+        _LOG.info("live resize: generation %d -> %d, world %d -> %d, "
+                  "rank -> %d (epoch %d, batch %d)", self.gen, gen,
+                  old_world, new_world, my_rank, epoch, nbatch)
+        true_nbatch = nbatch + (self.nbatch_offset
+                                if epoch == self.resume_epoch else 0)
+        # 1. quiesce + host-export the live state through the checkpoint
+        # layout math — the old mesh is still intact here, and the
+        # transition sits between two optimizer steps by construction
+        man, params, opt_state, aux = fast.export_state(
+            epoch=epoch, nbatch=true_nbatch)
+        # 2. tear down the old runtime without a peer handshake (a
+        # member may be gone) and re-point the MXTPU env contract —
+        # world size, rank, and the generation's FRESH coordinator
+        dist.shutdown_process_group(graceful=False)
+        os.environ["MXTPU_COORDINATOR"] = str(plan["coordinator"])
+        os.environ["MXTPU_NUM_PROCESSES"] = str(new_world)
+        os.environ["MXTPU_PROCESS_ID"] = str(my_rank)
+        if new_world > 1:
+            dist.init_process_group()
+        # 3. the collective checker rebases at the seam on every member
+        # of the new world — pre-resize history must not be compared
+        # against a joiner that was not there for it
+        _san.collective_rebase("resize-g%d" % gen)
+        # 4. hand the resume state to joining ranks: the surviving rank
+        # with the lowest NEW rank publishes once per generation
+        if join and new_world > 1:
+            survivors = [int(r) for s, r in assign.items() if s not in join]
+            if my_rank == min(survivors):
+                dist.kv_set(_state_key(gen),
+                            _encode_state(man, params, opt_state, aux))
+        # 5. rebuild the fused step in place on the new world and
+        # re-place the state device-to-device (no disk, exact update
+        # count) — the fit loop resumes with the SAME fast object.  The
+        # rebuild re-traces the world-keyed fused-fit cache by design;
+        # budget that compile wave so the RECOMPILE checker stays armed
+        # across the seam without reporting the transition itself
+        _san.expect_recompile("resize-g%d" % gen)
+        fast.apply_resize(man, params, opt_state, aux)
+        self.plan = plan
+        self.gen = gen
+        self._seq = 0
+        seconds = time.monotonic() - t0
+        _record({"kind": "shrink" if new_world < old_world else "grow",
+                 "gen": gen, "world": new_world, "from_world": old_world,
+                 "epoch": int(epoch), "nbatch": int(true_nbatch),
+                 "step": int(man["step"]), "seconds": round(seconds, 3),
+                 "lost_steps": 0, "time": time.time()})
+        _tel.counter("elastic_resizes")
+        _tel.counter("resize_lost_steps", 0)
+        _tel.gauge("resize_seconds", seconds)
+        _tel.gauge("dist_world_size", new_world)
+        _tel.gauge("dist_rank", my_rank)
+        _LOG.info("live resize: generation %d live at world %d in %.2fs "
+                  "(step %d preserved, 0 steps lost)", gen, new_world,
+                  seconds, int(man["step"]))

@@ -105,7 +105,8 @@ __all__ = ["SanitizerError", "SanitizerWarning", "arm", "disarm", "armed",
            "check_donated", "donated_entry", "total_cache_entries",
            "caches", "stats", "violations", "reset", "note_collective",
            "collective_dispatch", "collective_sync", "collective_sig",
-           "allow_thread_collective", "ledger_tail", "collective_state"]
+           "allow_thread_collective", "ledger_tail", "collective_state",
+           "expect_recompile"]
 
 CHECKERS = ("recompile", "sync", "donate", "collective")
 
@@ -617,6 +618,11 @@ _coll_chain = "0" * 40    # rolling sha1 over the canonical entry stream
 _coll_xchg = 0            # exchange-point counter (agrees across ranks as
                           # long as every rank reaches the same barriers /
                           # epoch boundaries — which is what is checked)
+_coll_gen = 0             # rebase generation: bumps at each live-resize
+                          # membership transition (collective_rebase) so
+                          # pre-transition chained entries stop feeding
+                          # the exchanged tail — a fresh joiner has no
+                          # pre-transition history to compare against
 _coll_inflight = {}       # thread ident -> (entry, monotonic start)
 _coll_stalled = set()     # entry seqs already dumped (one bundle each)
 _coll_watch_thread = None
@@ -691,6 +697,11 @@ def note_collective(kind, name=None, sig=None, axes=None, device=True):
             # exchange diff aligns on.
             _coll_mseq += 1
             entry["mseq"] = _coll_mseq
+            if _coll_gen:
+                # post-rebase entries carry their generation so the
+                # exchanged tail can exclude pre-transition history
+                # (entries without the key predate the first rebase)
+                entry["gen"] = _coll_gen
             _coll_chain = hashlib.sha1(
                 (_coll_chain + _coll_canon(entry)).encode()).hexdigest()
         _coll_ledger.append(entry)
@@ -762,9 +773,12 @@ class _AllowThreadCollective(object):
 
 def allow_thread_collective(reason):
     """Scoped escape hatch for a *deliberately* off-main-thread device
-    collective (elastic ``health_check``'s bounded, generation-suffixed
-    probe barrier).  Counted, never flagged; the reason documents the
-    protocol the same way ``allow_sync`` does."""
+    collective.  Counted, never flagged; the reason documents the
+    protocol the same way ``allow_sync`` does.  The repo itself has no
+    remaining user — elastic ``health_check``, the one historical case,
+    now rides ``dist.membership_barrier`` (service RPC, no device
+    collective, no thread) — but the hatch stays for embedders whose
+    bounded probes the THR002/collective checkers cannot know about."""
     if not _collective_on:
         return _NOOP
     return _AllowThreadCollective()
@@ -795,7 +809,8 @@ def _coll_payload():
     is comparable across ranks (global ledger seqs shift with
     rank-local side-thread dispatches)."""
     with _lock:
-        chained = [e for e in _coll_ledger if "mseq" in e]
+        chained = [e for e in _coll_ledger
+                   if "mseq" in e and e.get("gen", 0) == _coll_gen]
         return {"seq": _coll_mseq, "chain": _coll_chain,
                 "tail": [{"seq": e["mseq"], "kind": e["kind"],
                           "name": e["name"], "sig": e["sig"],
@@ -867,6 +882,55 @@ def _divergence_message(point, n, rank, mine, peers):
            _COLL_TAIL, mine["seq"]))
 
 
+def expect_recompile(marker):
+    """Declare an upcoming LEGITIMATE recompile wave: every registered
+    cache's warmup budget counts from this point, so the re-trace is not
+    reported as an unstable key.  A live world resize
+    (parallel/resize.py) is the canonical caller — the fused-fit cache
+    is keyed on the world size on purpose (a program traced for the old
+    mesh must never run on the new one), so every transition pays
+    exactly the compile wave this budgets for.  Warm keys are KEPT: a
+    second unexplained miss after the wave still diffs against the
+    pre-transition keys.  Safe to call with the checker off."""
+    import logging
+    with _lock:
+        for h in _CACHES:
+            h._miss_anchor = h._misses
+            h._warned = 0
+    logging.getLogger(__name__).info(
+        "mxsan: recompile budgets re-armed at %s", marker)
+
+
+def collective_rebase(marker):
+    """Rebase the cross-rank verification state at a world membership
+    transition (live resize — parallel/resize.py): the hash chain, chain
+    position and exchange counter restart from a marker-derived seed.
+    Every member of the NEW world — survivors and joiners alike — calls
+    this with the SAME marker before its next exchange: a survivor's
+    pre-transition history can never align with a freshly joined rank,
+    so verification restarts AT the transition instead of reporting the
+    membership change itself as a divergence (the rebuilt world's
+    dispatch order is still verified from the seam onward).  The ledger
+    is kept — pre-transition entries remain forensic evidence, a
+    ``rebase`` row marks the seam — but stops feeding the exchanged
+    tail.  No-op while the checker is off."""
+    import hashlib
+    global _coll_chain, _coll_mseq, _coll_xchg, _coll_seq, _coll_gen
+    if not _collective_on:
+        return
+    with _lock:
+        _coll_gen += 1
+        _coll_chain = hashlib.sha1(
+            ("rebase:%s" % (marker,)).encode()).hexdigest()
+        _coll_mseq = 0
+        _coll_xchg = 0
+        _coll_seq += 1
+        _coll_ledger.append({"seq": _coll_seq, "kind": "rebase",
+                             "name": str(marker), "sig": None,
+                             "axes": None, "gen": _coll_gen,
+                             "thread": threading.current_thread().name})
+
+
 def _coord_client():
     # ONE owner for the fragile jax-internal lookup:
     # parallel.dist.coordination_client (coordination_barrier rides the
@@ -877,6 +941,25 @@ def _coord_client():
         return _dist.coordination_client()
     except Exception:
         return None
+
+
+def _coord_world(client):
+    """``(world, rank)`` for the hash-chain exchange: the device
+    backend's world when it is multi-process, else — the
+    coordination-only coupling a live resize runs in — the MXTPU env
+    contract, provided a client is actually connected.  Mirrors
+    ``dist.peer_world`` without re-entering dist (whose idempotence
+    latch may be mid-transition during a resize)."""
+    import jax
+    if jax.process_count() > 1:
+        return jax.process_count(), jax.process_index()
+    if client is not None:
+        try:
+            from . import checkpoint as _ckpt
+            return _ckpt._world(), _ckpt._rank()
+        except Exception:
+            return 1, 0
+    return 1, 0
 
 
 def collective_sync(point, timeout_s=None):
@@ -901,10 +984,10 @@ def collective_sync(point, timeout_s=None):
         # the ledger; the main thread's next exchange carries the chain.
         return
     import json
-    import jax
-    if jax.process_count() <= 1:
-        return
     client = _coord_client()
+    world, rank = _coord_world(client)
+    if world <= 1:
+        return
     if client is None:
         with _lock:
             warned, _coll_client_warned = _coll_client_warned, True
@@ -920,7 +1003,6 @@ def collective_sync(point, timeout_s=None):
     with _lock:
         _coll_xchg += 1
         n = _coll_xchg
-    rank = jax.process_index()
     # one encode: the published bytes, re-decoded for the local copy so
     # the entry diff compares like with like (peers arrive JSON-decoded;
     # tuples become lists)
@@ -952,7 +1034,7 @@ def collective_sync(point, timeout_s=None):
     # would otherwise sit k*timeout inside the barrier's pre-wait
     # exchange while the stall watchdog fires on the enclosing dispatch)
     deadline = time.monotonic() + timeout_s
-    for r in range(jax.process_count()):
+    for r in range(world):
         if r == rank:
             continue
         left_ms = max(1, int((deadline - time.monotonic()) * 1000))
@@ -1240,7 +1322,7 @@ def reset():
     counts, the collective ledger/hash chain and every cache's miss
     anchor (test isolation)."""
     global _coll_seq, _coll_mseq, _coll_chain, _coll_xchg, \
-        _coll_client_warned
+        _coll_client_warned, _coll_gen
     with _lock:
         for k in _stats:
             _stats[k] = 0
@@ -1254,6 +1336,7 @@ def reset():
         _coll_mseq = 0
         _coll_chain = "0" * 40
         _coll_xchg = 0
+        _coll_gen = 0
         _coll_client_warned = False
         for h in _CACHES:
             h._miss_anchor = h._misses

@@ -801,6 +801,20 @@ class TrainStep(object):
         without a policy.  Syncs three scalars — checkpoint-time only."""
         return _scale_state_to_host(self)
 
+    def export_host(self, params, opt_state, aux):
+        """LOGICAL host export of a live training state: ``(manifest,
+        params, opt_state, aux)`` exactly as a checkpoint save + load of
+        this step would produce, without touching disk — one batched
+        device→host fetch through the checkpoint writer's snapshot
+        layout, reassembled by the restore path's group math.  The live
+        resize (parallel/resize.py) feeds this straight into
+        ``checkpoint.restore_loaded`` on a step built for the NEW
+        topology, which makes the in-place re-shard bitwise equal to a
+        save/restore round trip by construction."""
+        from . import checkpoint as _ckpt
+        return _ckpt.reassemble(_ckpt.snapshot(self, params, opt_state,
+                                               aux))
+
     def load_scale_state(self, host):
         """Restore the loss-scale automaton from checkpointed host scalars
         (no-op without a policy: an f32 restore of an AMP checkpoint
@@ -1678,6 +1692,15 @@ class PipelineTrainStep(object):
         """Loss-scale state as host scalars, or None without a policy
         (mirrors TrainStep.scale_state_host)."""
         return _scale_state_to_host(self)
+
+    def export_host(self, params, opt_state, aux):
+        """LOGICAL host export of a live pipelined training state
+        (mirrors TrainStep.export_host — same snapshot/reassemble round
+        trip, with the stage partition merged away; the live-resize
+        re-shard path)."""
+        from . import checkpoint as _ckpt
+        return _ckpt.reassemble(_ckpt.snapshot(self, params, opt_state,
+                                               aux))
 
     def load_scale_state(self, host):
         """Restore the loss-scale automaton onto the final stage's

@@ -201,18 +201,19 @@ def test_multi_rule_module_filters_to_selected_rule():
     assert f2 and all(f.rule == "COLL002" for f in f2)
 
 
-def test_repo_health_probe_is_the_one_thr2_suppression():
-    """The repo's single sanctioned off-main-thread device collective:
-    elastic health_check's bounded probe barrier, suppressed with its
-    protocol — and nothing else."""
+def test_repo_has_zero_thr2_sites():
+    """THR002 holds repo-wide BY CONSTRUCTION: elastic health_check —
+    historically the one waived site (a daemon-thread device barrier
+    racing a timeout) — now rides dist.membership_barrier, a bounded
+    coordination-service RPC on the calling thread.  No findings, and
+    no suppressions hiding any."""
     from tools.mxlint.core import Project
     from tools.mxlint import rule_thr2
     p = Project(ROOT)
-    findings = rule_thr2.run(p)
-    assert [(f.rel, f.context) for f in findings] == \
-        [("mxnet_tpu/parallel/elastic.py", "health_check._barrier")]
+    assert [(f.rel, f.context) for f in rule_thr2.run(p)] == []
     fi = p.file("mxnet_tpu/parallel/elastic.py")
-    assert fi.suppressed("THR002", findings[0].line)
+    assert not any("THR002" in rules
+                   for rules in fi.suppressions.values())
 
 
 # ---------------------------------------------------------------- machinery

@@ -151,36 +151,89 @@ def test_health_check_generation_suffix(monkeypatch):
     """A timed-out check's stale barrier must not be able to satisfy a LATER
     check: every call uses a fresh process-local generation suffix (ADVICE r2
     finding; the slow-but-alive hazard).  Also pins the collective-call
-    contract: same call count -> same name sequence."""
+    contract: same call count -> same name sequence.  The probe rides
+    dist.membership_barrier — a bounded coordination-service RPC on the
+    CALLING thread, so there is no daemon-thread device collective left
+    to suppress (THR002 holds by construction, not by waiver)."""
     from mxnet_tpu.parallel import dist
     seen = []
 
-    def fake_barrier(name):
-        seen.append(name)
+    def fake_barrier(name, timeout_ms=0):
+        seen.append((name, timeout_ms))
+        return True
 
-    monkeypatch.setattr(dist, "barrier", fake_barrier)
+    monkeypatch.setattr(dist, "membership_barrier", fake_barrier)
     assert elastic.health_check(timeout=5.0)
     assert elastic.health_check(timeout=5.0)
-    assert len(seen) == 2 and seen[0] != seen[1]
-    # a hung barrier (never returns) times out but burns its generation,
-    # so the NEXT check cannot pair with the stale pending one
-    import threading
-    release = threading.Event()
+    assert len(seen) == 2 and seen[0][0] != seen[1][0]
+    # the probe's bound travels to the service in milliseconds
+    assert seen[-1][1] == 5000
+    # a failed probe (the service timed the barrier out) burns its
+    # generation, so the NEXT check cannot pair with the stale id
 
-    def hanging_barrier(name):
-        seen.append(name)
-        release.wait(30)
+    def failing_barrier(name, timeout_ms=0):
+        seen.append((name, timeout_ms))
+        return False
 
-    monkeypatch.setattr(dist, "barrier", hanging_barrier)
+    monkeypatch.setattr(dist, "membership_barrier", failing_barrier)
     assert not elastic.health_check(timeout=0.2)
-    hung_name = seen[-1]
-    monkeypatch.setattr(dist, "barrier", fake_barrier)
+    failed_name = seen[-1][0]
+    monkeypatch.setattr(dist, "membership_barrier", fake_barrier)
     assert elastic.health_check(timeout=5.0)
-    assert seen[-1] != hung_name
-    release.set()
+    assert seen[-1][0] != failed_name
 
 
 def test_num_dead_node_healthy_world():
     """Single process: the world is trivially healthy (reference API shape
     kvstore.h:242 — 0 means no dead nodes)."""
     assert elastic.num_dead_node(timeout=5) == 0
+
+
+def test_latest_checkpoint_five_digit_epoch(tmp_path):
+    """Epoch numbers are %04d-formatted but NOT 4-digit-bounded: epoch
+    10000 widens the filename to 5 digits (printf %04d is a minimum),
+    and the resume scan must still see it — a \\d{4} pattern would
+    silently resume at 9999 forever (the _STEP_RE \\d{8,} precedent)."""
+    prefix = str(tmp_path / "model")
+    for e in (9999, 10000):
+        _write_params("%s-%04d.params" % (prefix, e))
+    assert elastic.latest_checkpoint(prefix) == 10000
+
+
+def _fake_sharded(prefix, step, epoch, nbatch):
+    """A COMPLETE sharded checkpoint as far as the resume scan is
+    concerned: manifest written, zero shards (completeness checks
+    iterate the manifest's shard table)."""
+    import json
+    from mxnet_tpu import checkpoint as ckpt
+    d = "%s-step%08d%s" % (prefix, step, ckpt.SUFFIX)
+    os.makedirs(d)
+    with open(os.path.join(d, ckpt.MANIFEST), "w") as f:
+        json.dump({"format": ckpt.FORMAT, "version": ckpt.VERSION,
+                   "step": step, "epoch": epoch, "nbatch": nbatch,
+                   "shards": {}}, f)
+    return d
+
+
+def test_resume_point_sharded_wins_same_epoch(tmp_path):
+    """Ordering tie-break at the SAME epoch: a sharded step checkpoint
+    saved at (E, B) resumes at (E, B+1), which is strictly later than
+    the monolithic epoch-E position (E, 0) — mid-epoch progress must
+    not be thrown away just because an epoch file also exists."""
+    prefix = str(tmp_path / "model")
+    _write_params("%s-%04d.params" % (prefix, 2))
+    d = _fake_sharded(prefix, step=40, epoch=2, nbatch=4)
+    kind, pos, path, man = elastic._resume_point(prefix)
+    assert kind == "sharded"
+    assert pos == (2, 5)
+    assert path == d and man["step"] == 40
+
+
+def test_resume_point_stale_sharded_vs_newer_mono(tmp_path):
+    """A sharded checkpoint from a PREVIOUS epoch must lose to a newer
+    monolithic epoch file: (E-1, B+1) < (E, 0) however large B is —
+    epoch completion supersedes any mid-epoch position inside it."""
+    prefix = str(tmp_path / "model")
+    _write_params("%s-%04d.params" % (prefix, 3))
+    _fake_sharded(prefix, step=999, epoch=2, nbatch=7000)
+    assert elastic._resume_point(prefix) == ("mono", (3, 0), 3)

@@ -13,6 +13,8 @@ This tool renders the forensic content for humans:
 * the incident header (reason, time, pid/rank, stall age or exception),
 * the last heartbeat (which epoch/batch/collective was in flight),
 * every Python thread's stack at dump time,
+* the live-resize trajectory (elasticity v3: world-size history, last
+  membership transition, lost-step count) when the process resized,
 * the telemetry counter/gauge snapshot,
 * the tail of the telemetry event stream (what the run did just before).
 
@@ -115,6 +117,27 @@ def render(bundle, out=sys.stdout, events=10, stacks=True):
         for e in ledger[-16:]:
             out.write("    seq %-6s %-22s %s\n"
                       % (e.get("seq"), e.get("kind"), _fmt_coll(e, False)))
+
+    rz = bundle.get("resize") or (bundle.get("extra") or {}).get("resize")
+    if rz:
+        out.write("\nLive resize (elasticity v3)\n")
+        out.write("  resizes      %s    lost steps %s\n"
+                  % (rz.get("resizes"), rz.get("lost_steps")))
+        history = rz.get("history") or []
+        if history:
+            sizes = []
+            if history[0].get("from_world") is not None:
+                sizes.append(str(history[0]["from_world"]))
+            sizes += [str(h.get("world")) for h in history]
+            out.write("  world        %s\n" % " -> ".join(sizes))
+        last = rz.get("last") or {}
+        if last:
+            out.write("  last         %s gen %s at %s  (epoch %s batch %s "
+                      "step %s, %ss)\n"
+                      % (last.get("kind"), last.get("gen"),
+                         _fmt_ts(last.get("time")), last.get("epoch"),
+                         last.get("nbatch"), last.get("step"),
+                         last.get("seconds")))
 
     tel = bundle.get("telemetry") or {}
     counters = tel.get("counters") or {}

@@ -111,6 +111,151 @@ def launch_local(n, command, env_extra=None, max_restarts=0):
               % (rc, attempt, max_restarts), file=sys.stderr)
 
 
+def _write_plan(path, gen, world, coordinator, assign, join=()):
+    """Atomically publish a world-plan generation (the supervisor half of
+    the protocol mxnet_tpu/parallel/resize.py consumes; same field set as
+    resize.write_plan, duplicated so the supervisor stays importable
+    without the runtime package).  Write-to-temp + fsync + rename: a
+    worker's per-step ``os.stat`` poll never observes a torn plan."""
+    import json
+    plan = {"gen": int(gen), "world": int(world),
+            "coordinator": str(coordinator),
+            "assign": {str(k): int(v) for k, v in dict(assign).items()},
+            "join": [str(s) for s in join]}
+    tmp = "%s.tmp-%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(plan, f, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return plan
+
+
+def launch_elastic(n, command, wmin, wmax, env_extra=None, max_restarts=0,
+                   respawn_delay=3.0):
+    """Elastic supervisor (elasticity v3, docs/elastic.md "Live resize"):
+    run ``n`` workers locally and treat membership changes as LIVE
+    TRANSITIONS instead of whole-world restarts.
+
+    Unlike ``launch_local``'s restart mode, a worker death here never
+    kills the survivors: as long as ``wmin`` workers remain, the
+    supervisor publishes a new world-plan generation (survivors re-rank
+    and resize in place via their ResizeController), then — budget
+    (``max_restarts``) and cap (``wmax``) permitting — respawns the dead
+    slot as a JOIN after ``respawn_delay`` seconds, long enough for the
+    survivors to observe the shrink generation first.  A joiner receives
+    its resume state over the coordination service from a survivor
+    (``MXTPU_ELASTIC_JOIN=1``), not from a checkpoint.
+
+    Every process keeps an immutable ``MXTPU_SLOT`` launch identity; its
+    RANK is whatever the current plan generation assigns (a survivor
+    becomes rank 0 when the old rank 0 dies).  Each generation gets a
+    fresh coordinator port — coordination-service state is single-use.
+    Returns the first unrecoverable non-zero exit code (0 otherwise)."""
+    import shutil
+    import tempfile
+    import time
+    if not 1 <= wmin <= n <= wmax:
+        raise ValueError("--elastic bounds must satisfy 1 <= min <= n <= "
+                         "max; got min=%d n=%d max=%d" % (wmin, n, wmax))
+    plan_dir = tempfile.mkdtemp(prefix="mxtpu-elastic-")
+    plan_path = os.path.join(plan_dir, "world_plan.json")
+    gen = 1
+    assign = {str(i): i for i in range(n)}
+    plan = _write_plan(plan_path, gen, n, "localhost:%d" % _free_port(),
+                       assign)
+    procs = {}
+    respawns = 0
+
+    def spawn(slot, plan, join=False):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env["MXTPU_COORDINATOR"] = plan["coordinator"]
+        env["MXTPU_NUM_PROCESSES"] = str(plan["world"])
+        env["MXTPU_PROCESS_ID"] = str(plan["assign"][slot])
+        env["MXTPU_SLOT"] = slot
+        env["MXTPU_RESTART_COUNT"] = str(respawns)
+        env["MXNET_ELASTIC_PLAN"] = plan_path
+        if join:
+            env["MXTPU_ELASTIC_JOIN"] = "1"
+        else:
+            env.pop("MXTPU_ELASTIC_JOIN", None)
+        procs[slot] = subprocess.Popen(command, env=env)
+
+    for i in range(n):
+        spawn(str(i), plan)
+    rc_final = 0
+    try:
+        while True:
+            dead = []
+            for slot in sorted(procs):
+                c = procs[slot].poll()
+                if c == 0:
+                    del procs[slot]    # finished cleanly — not a failure
+                elif c is not None:
+                    dead.append((slot, c))
+                    del procs[slot]
+            if not procs and not dead:
+                return rc_final
+            if dead:
+                for slot, c in dead:
+                    print("launch.py: slot %s died (rc=%d)" % (slot, c),
+                          file=sys.stderr)
+                survivors = sorted(procs)
+                if len(survivors) < wmin:
+                    print("launch.py: %d survivor(s) < --elastic min %d — "
+                          "tearing the world down" % (len(survivors), wmin),
+                          file=sys.stderr)
+                    return dead[0][1]
+                # SHRINK generation: survivors re-rank 0..k-1 and resize
+                # in place — no process is killed or restarted
+                gen += 1
+                assign = {s: r for r, s in enumerate(survivors)}
+                plan = _write_plan(plan_path, gen, len(survivors),
+                                   "localhost:%d" % _free_port(), assign)
+                print("launch.py: plan gen %d — world shrinks to %d "
+                      "(survivors resize in place)" % (gen, len(survivors)),
+                      file=sys.stderr)
+                # re-GROW: respawn dead slots as JOINS while the restart
+                # budget and the world cap allow
+                joiners = []
+                for slot, _c in dead:
+                    if respawns >= max_restarts:
+                        break
+                    if len(survivors) + len(joiners) >= wmax:
+                        break
+                    respawns += 1
+                    joiners.append(slot)
+                if joiners and survivors:
+                    # survivors must observe (and complete) the shrink
+                    # generation before the join generation lands
+                    time.sleep(respawn_delay)
+                    gen += 1
+                    assign = {s: r for r, s in enumerate(survivors)}
+                    for slot in sorted(joiners):
+                        assign[slot] = len(assign)
+                    plan = _write_plan(plan_path, gen,
+                                       len(survivors) + len(joiners),
+                                       "localhost:%d" % _free_port(),
+                                       assign, join=joiners)
+                    print("launch.py: plan gen %d — world grows to %d "
+                          "(slot(s) %s join live)"
+                          % (gen, plan["world"], ",".join(sorted(joiners))),
+                          file=sys.stderr)
+                    for slot in joiners:
+                        spawn(slot, plan, join=True)
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for p in procs.values():
+            p.send_signal(signal.SIGINT)
+        return 1
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(plan_dir, ignore_errors=True)
+
+
 def launch_ssh(hosts, command, env_extra=None):
     """One process per host over ssh; process 0's host is the coordinator."""
     port = _free_port()
@@ -144,12 +289,34 @@ def main():
                     help="file with one host per line (ssh launcher)")
     ap.add_argument("--max-restarts", type=int, default=0,
                     help="elastic supervision: respawn the world up to this "
-                         "many times after a worker failure")
+                         "many times after a worker failure (with --elastic: "
+                         "the JOIN respawn budget — dead ranks re-enter the "
+                         "live world instead of restarting it)")
+    ap.add_argument("--elastic", default=None, metavar="MIN:MAX",
+                    help="live-resize supervision (local launcher only): "
+                         "keep survivors alive through worker deaths while "
+                         "at least MIN remain, growing back up to MAX by "
+                         "respawning dead slots as live joins "
+                         "(docs/elastic.md \"Live resize\")")
+    ap.add_argument("--respawn-delay", type=float, default=3.0,
+                    help="--elastic: seconds between publishing a shrink "
+                         "generation and respawning the dead slot as a join "
+                         "(survivors must observe the shrink first)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
         ap.error("no command given")
-    if args.launcher == "local":
+    if args.elastic is not None and args.launcher != "local":
+        ap.error("--elastic requires the local launcher")
+    if args.launcher == "local" and args.elastic is not None:
+        try:
+            wmin, wmax = (int(v) for v in args.elastic.split(":"))
+        except ValueError:
+            ap.error("--elastic expects MIN:MAX (e.g. 1:4)")
+        rc = launch_elastic(args.num_workers, args.command, wmin, wmax,
+                            max_restarts=args.max_restarts,
+                            respawn_delay=args.respawn_delay)
+    elif args.launcher == "local":
         rc = launch_local(args.num_workers, args.command,
                           max_restarts=args.max_restarts)
     else:

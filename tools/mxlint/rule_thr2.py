@@ -17,12 +17,15 @@ Thread-reachable = functions passed as ``threading.Thread`` /
 submitted to a ``concurrent.futures`` executor (``pool.submit(f, ...)``)
 — propagated through same-file calls the way JIT001 propagates tracing.
 
-A deliberately-bounded probe (elastic ``health_check``'s
-generation-suffixed barrier) carries an inline suppression naming its
-protocol — and declares itself to the runtime twin with
-``sanitize.allow_thread_collective``.  mxsan's ``collective`` checker is
-this rule's dynamic half: a device dispatch noted off the main thread is
-a named runtime violation.
+No repo code suppresses this rule anymore: elastic ``health_check`` —
+historically the one waived site, a daemon-thread device barrier racing
+a timeout — now rides ``dist.membership_barrier`` (a bounded
+coordination-service RPC on the calling thread), so the rule holds
+everywhere by construction.  A genuinely unavoidable bounded protocol
+would carry an inline suppression naming it AND declare itself to the
+runtime twin with ``sanitize.allow_thread_collective``.  mxsan's
+``collective`` checker is this rule's dynamic half: a device dispatch
+noted off the main thread is a named runtime violation.
 """
 from __future__ import annotations
 
