@@ -294,6 +294,15 @@ def snapshot(reason, exc=None, extra=None):
     except Exception:   # diagnostics must never add a second failure
         pass
     try:
+        # flight recorder (MXNET_FLIGHT_RECORDER=N): the ring of the last
+        # N events — the "last seconds before the incident" timeline that
+        # exists even when full telemetry was never armed
+        fr = _tel.flight_recorder()
+        if fr is not None:
+            bundle["flight_recorder"] = fr
+    except Exception:   # diagnostics must never add a second failure
+        pass
+    try:
         from .parallel import resize as _resize
         rz = _resize.stats()
         if rz["history"]:
@@ -341,8 +350,10 @@ def write_snapshot(reason, exc=None, extra=None):
 
 def crash_snapshots_active():
     """Crash bundles write when ANY diagnostics feature is opted into —
-    the watchdog, the sentinel, or MXNET_DIAG_DIR alone."""
-    if _armed or get_env("MXNET_DIAG_DIR") is not None:
+    the watchdog, the sentinel, the flight recorder, or MXNET_DIAG_DIR
+    alone."""
+    if _armed or get_env("MXNET_DIAG_DIR") is not None \
+            or _tel.flight_recorder_armed():
         return True
     try:
         return check_numerics_mode() is not None
@@ -551,6 +562,107 @@ def sample_device_memory(**tags):
     return per_dev
 
 
+# ------------------------------------- flight-recorder flush triggers
+# The crash snapshot covers exceptions escaping Module.fit, and the mxsan
+# watchdog covers collective stalls — but a flight-recorder-armed process
+# must also leave its ring behind for (a) exceptions that never pass
+# through fit (data pipeline setup, serving loops) and (b) a SIGTERM from
+# a launcher/scheduler killing one rank of a fleet.  Both hooks install
+# ONLY when the ring is armed at import (zero-overhead contract), chain or
+# restore prior behaviour, and never add a second failure.
+_fr_prev_excepthook = None
+_fr_prev_sigterm = None
+_fr_sigterm_wired = False
+
+
+def _fr_excepthook(exc_type, exc, tb):
+    try:
+        if not issubclass(exc_type, (KeyboardInterrupt, SystemExit)):
+            write_snapshot("unhandled_exception", exc=exc)
+    except Exception:   # noqa: BLE001 — must not mask the real crash
+        pass
+    (_fr_prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+
+def _fr_on_sigterm(signum, frame):
+    import signal
+    try:
+        write_snapshot("fatal_signal",
+                       extra={"signal": int(signum), "signal_name": "SIGTERM"})
+    except Exception:   # noqa: BLE001
+        pass
+    prev = _fr_prev_sigterm
+    if callable(prev):
+        # a chained application handler (jax's preemption notifier after
+        # distributed init) OWNS the death semantics — graceful
+        # preemption relies on the process surviving to the next step
+        # boundary, so the hook only buys the bundle write and defers
+        try:
+            prev(signum, frame)
+        except Exception:   # noqa: BLE001 — never add a second failure
+            pass
+        return
+    # no prior handler: restore the default disposition and re-deliver,
+    # so the process still dies by SIGTERM (exit status, parent waitpid
+    # semantics) — the handler only buys the bundle write
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+def _fr_wire():
+    """Install the flight-recorder flush triggers (import time, armed
+    processes only).  The SIGTERM hook only takes a handler slot that was
+    at the default disposition — an application handler wins."""
+    global _fr_prev_excepthook, _fr_prev_sigterm, _fr_sigterm_wired
+    if not _tel.flight_recorder_armed():
+        return False
+    if _fr_prev_excepthook is None:
+        _fr_prev_excepthook = sys.excepthook
+        sys.excepthook = _fr_excepthook
+    try:
+        import signal
+        if threading.current_thread() is threading.main_thread() \
+                and signal.getsignal(signal.SIGTERM) == signal.SIG_DFL:
+            _fr_prev_sigterm = None
+            signal.signal(signal.SIGTERM, _fr_on_sigterm)
+            _fr_sigterm_wired = True
+    except (ValueError, OSError, RuntimeError):
+        pass   # non-main thread / exotic platform: excepthook still covers
+    return True
+
+
+def fr_rewire_sigterm():
+    """Re-assert the flight-recorder SIGTERM hook after jax's
+    distributed init: the runtime installs its preemption notifier on
+    SIGTERM at the C level — invisible to ``signal.getsignal`` — which
+    displaces the import-time hook in exactly the fleet case the
+    recorder exists for (a launcher/scheduler killing one rank).
+    ``dist.init_process_group`` calls this once the runtime is up.  A
+    Python-level application handler found in the slot is chained after
+    the bundle write and keeps its own death semantics; the C-level
+    notifier cannot be observed or chained and is displaced — an armed
+    ring means the operator asked for post-mortem bundles on kill.
+    No-op unless armed."""
+    global _fr_prev_sigterm, _fr_sigterm_wired
+    if not _tel.flight_recorder_armed():
+        return False
+    try:
+        import signal
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        cur = signal.getsignal(signal.SIGTERM)
+        if callable(cur) and cur is not _fr_on_sigterm:
+            _fr_prev_sigterm = cur
+        # unconditional re-install: when a C-level handler holds the OS
+        # slot, getsignal still names whatever Python set last — trusting
+        # it would no-op exactly when the rewire is needed
+        signal.signal(signal.SIGTERM, _fr_on_sigterm)
+        _fr_sigterm_wired = True
+        return True
+    except (ValueError, OSError, RuntimeError):
+        return False   # exotic platform: the excepthook still covers
+
+
 # ------------------------------------------------- autostart (env contract)
 def _autoarm():
     """MXNET_WATCHDOG_SEC arms the watchdog at import time (the env-var
@@ -565,3 +677,4 @@ def _autoarm():
 
 
 _autoarm()
+_fr_wire()

@@ -5,7 +5,9 @@ registry (telemetry.py) of THIS process:
 
 * ``GET /metrics``       — Prometheus text exposition (counters, gauges,
   histograms with cumulative ``le`` buckets; span-fed latency histograms
-  are in microseconds),
+  are in microseconds), led by an ``mxnet_build_info`` gauge whose labels
+  carry the package + jax versions and every trace-affecting env lever
+  (``base.TRACE_ENV_DEFAULTS``),
 * ``GET /metrics.json``  — JSON snapshot (counters, gauges, histograms
   with p50/p90/p99 estimates),
 * ``GET /healthz``       — liveness probe.
@@ -35,10 +37,10 @@ import time
 import warnings
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from .base import get_env
+from .base import TRACE_ENV_DEFAULTS, get_env, trace_env_key
 from . import telemetry as _tel
 
-__all__ = ["start_server", "stop_server", "server_port",
+__all__ = ["start_server", "stop_server", "server_port", "build_info",
            "prometheus_text", "json_snapshot", "parse_endpoint"]
 
 _lock = threading.Lock()
@@ -71,9 +73,44 @@ def _fmt(v):
     return str(v)
 
 
+_jax_version = None
+
+
+def build_info():
+    """{label: value} identifying this process's build: package version,
+    jax version, and every trace-affecting env lever from
+    ``base.TRACE_ENV_DEFAULTS`` (the jit-cache-key fields) — so a fleet
+    scrape can spot the one worker running with a different flag before
+    chasing its timings.  The jax version comes from package metadata, not
+    ``import jax`` (a scrape must not pull the ML stack into a process
+    that never imported it)."""
+    global _jax_version
+    if _jax_version is None:
+        try:
+            from importlib.metadata import version as _pkg_version
+            _jax_version = _pkg_version("jax")
+        except Exception:   # jax absent or metadata unreadable
+            _jax_version = "unknown"
+    from . import __version__
+    info = {"version": __version__, "jax_version": _jax_version}
+    for (name, _default), value in zip(TRACE_ENV_DEFAULTS, trace_env_key()):
+        info[name.lower()] = str(value)
+    return info
+
+
+def _escape_label(v):
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
 def prometheus_text():
     """Text exposition (version 0.0.4) of the live telemetry registry."""
     lines = []
+    # constant info gauge (value 1, identity in the labels) — the
+    # Prometheus convention for build metadata, cf. python_info
+    lines.append("# TYPE mxnet_build_info gauge")
+    extra = ['%s="%s"' % (k, _escape_label(v))
+             for k, v in sorted(build_info().items())]
+    lines.append("mxnet_build_info%s 1" % _labels(extra))
     for name, v in sorted(_tel.counters().items()):
         # the conventional _total suffix also keeps counter families from
         # colliding with a span histogram of the sanitized same name
@@ -125,6 +162,7 @@ def json_snapshot():
         "ts": time.time(),
         "rank": get_env("MXTPU_PROCESS_ID"),
         "recording": _tel.enabled(),
+        "build_info": build_info(),
         "counters": _tel.counters(),
         "gauges": _tel.gauges(),
         "histograms": hists,

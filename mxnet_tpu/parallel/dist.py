@@ -169,6 +169,15 @@ def init_process_group():
     pid = get_env("MXTPU_PROCESS_ID", typ=int)
     if coord and nproc and nproc > 1:
         _connect(coord, nproc, pid or 0)
+        # jax.distributed puts its preemption notifier on SIGTERM,
+        # displacing the flight recorder's import-time hook — re-assert
+        # it (chaining the notifier) so a killed rank still leaves its
+        # ring in a bundle.  No-op unless MXNET_FLIGHT_RECORDER armed.
+        try:
+            from .. import diagnostics as _diag
+            _diag.fr_rewire_sigterm()
+        except Exception:
+            pass
     _initialized = True
     from .. import telemetry as _tel
     if _tel._enabled:
@@ -231,6 +240,8 @@ def shutdown_process_group(graceful=False):
     _initialized = False
     _worker_mesh = None
     _sum_cache.clear()
+    # clock offsets are world-relative: the next world re-estimates
+    _clock_reset()
 
 
 def rank():
@@ -271,6 +282,7 @@ def barrier(name=None):
             name = "kvstore-%d" % _barrier_seq[0]
     from jax.experimental import multihost_utils
     from .. import sanitize as _san
+    _clock_exchange()
     with _san.collective_dispatch("barrier", name=name):
         # exchange BEFORE waiting: two ranks arriving with different
         # barrier names (or divergent dispatch histories) are named here
@@ -390,6 +402,7 @@ def coordination_barrier(name, timeout_ms=600000):
         # ranks still meet each other here, through the service.
         return
     from .. import sanitize as _san
+    _clock_exchange()
     # device=False: the service barrier is thread-safe by design — the
     # checkpoint writer thread meeting its peers here is the sanctioned
     # pattern, not an off-main-thread violation
@@ -411,6 +424,118 @@ def coordination_barrier(name, timeout_ms=600000):
                 "collective fallback is unsafe off the main thread")
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(name)
+
+
+# --------------------------------------------------------------------------
+# Cross-rank clock exchange (the fleet-timeline substrate)
+# --------------------------------------------------------------------------
+# Per-rank telemetry streams timestamp with the LOCAL wall clock; merging
+# them into one fleet timeline (tools/trace_merge.py) needs each rank's
+# offset against a reference.  At every barrier entry — a point all ranks
+# reach together, so the true arrival spread bounds the error — each rank
+# publishes ``(monotonic, wall)`` under a seq-numbered key on the
+# coordination service (key-value RPC ONLY: no device collective, so the
+# COLL rules and the mxsan ledger stay silent) and estimates its offset
+# against rank 0 as the running median of the wall-clock deltas.  The
+# estimate rides the event stream as the ``clock_offset_sec`` gauge, so a
+# telemetry JSONL or a flight-recorder bundle is self-describing for the
+# merge.  Gated on ``_tel._enabled`` (full telemetry OR an armed flight
+# recorder): with both off, nothing is published and no state accrues —
+# the zero-overhead contract, pinned in test_import_noop.  Main-thread
+# only, like mxsan's hash-chain exchange: the seq numbering must advance
+# identically on every rank.
+_clock_lock = threading.Lock()
+_clock_seq = 0
+_clock_samples = []       # wall-delta samples vs rank 0 (bounded)
+_clock_offset = None      # current median estimate (seconds)
+_CLOCK_SAMPLES_KEEP = 64
+_CLOCK_TIMEOUT_MS = 5000
+
+
+def clock_offset():
+    """Latest estimated wall-clock offset of this rank against rank 0
+    (seconds; positive = this rank's clock runs ahead), or None before
+    the first exchange.  Rank 0 reports 0.0."""
+    return _clock_offset
+
+
+def _clock_reset():
+    global _clock_seq, _clock_samples, _clock_offset
+    with _clock_lock:
+        _clock_seq = 0
+        _clock_samples = []
+        _clock_offset = None
+
+
+def _clock_exchange():
+    """One clock sample exchange at a barrier entry (see above).  Must
+    never fail or stall the barrier: every service error degrades to a
+    lost sample."""
+    global _clock_seq, _clock_offset
+    from .. import telemetry as _tel
+    if not _tel._enabled:
+        return
+    if threading.current_thread() is not threading.main_thread():
+        # seq numbering must advance in the same order on every rank;
+        # side-thread barriers (the async checkpoint writer) interleave
+        # nondeterministically — same rule as mxsan's exchange
+        return
+    client = coordination_client()
+    if client is None:
+        return
+    try:
+        world, myrank = peer_world()
+    except Exception:
+        return
+    if world <= 1:
+        return
+    import time as _time
+    with _clock_lock:
+        _clock_seq += 1
+        n = _clock_seq
+    mono = _time.monotonic()
+    wall = _time.time()
+    try:
+        client.key_value_set("mxtpu-clock/%d/%d" % (n, myrank),
+                             "%.9f,%.9f" % (mono, wall))
+        if n > 2:
+            # reclaim this rank's round-(n-2) key (the mxsan-coll delete
+            # argument: anyone who published n-1 has finished reading
+            # n-2, and barriers order the rounds)
+            try:
+                client.key_value_delete("mxtpu-clock/%d/%d"
+                                        % (n - 2, myrank))
+            except Exception:
+                pass
+        if myrank == 0:
+            offset = 0.0
+        else:
+            raw = client.blocking_key_value_get("mxtpu-clock/%d/0" % n,
+                                                _CLOCK_TIMEOUT_MS)
+            _mono0, wall0 = (float(x) for x in str(raw).split(","))
+            offset = wall - wall0
+    except Exception:
+        return   # a lost sample must never fail the barrier
+    with _clock_lock:
+        _clock_samples.append(offset)
+        if len(_clock_samples) > _CLOCK_SAMPLES_KEEP:
+            del _clock_samples[0]
+        s = sorted(_clock_samples)
+        m = len(s) // 2
+        _clock_offset = s[m] if len(s) % 2 else 0.5 * (s[m - 1] + s[m])
+        est, nsamp = _clock_offset, len(s)
+    _tel.gauge("clock_offset_sec", est, rank=myrank, samples=nsamp)
+
+
+def wire_bytes():
+    """Cumulative collective payload bytes by ``"kind/axes"`` — folded
+    out of each dispatch's shape/dtype signature (metadata only, no
+    device syncs) while mxsan's collective checker OR telemetry records.
+    The same totals ride ``/metrics`` as ``coll_wire_bytes[kind/axes]``
+    counters; ROADMAP item 5's wire-efficiency work gates against the
+    ``dryrun_multichip`` wire ladder built on this accounting."""
+    from .. import sanitize as _san
+    return _san.wire_bytes()
 
 
 # --------------------------------------------------------------------------
@@ -493,7 +618,12 @@ def allreduce_arrays(arrays):
     # ledger entry from shape metadata only (the mxsan no-sync
     # discipline); the in-flight mark feeds the MXNET_SAN_COLL_TIMEOUT
     # deadlock watchdog while the collective blocks
-    sig = _san.collective_sig(arrays) if _san._collective_on else None
+    sig = None
+    if _san._collective_on or _tel._enabled:
+        sig = _san.collective_sig(arrays)
+        # wire-bytes ledger: payload bytes from the sig metadata (no
+        # device sync), per (kind, axes) — dist.wire_bytes() / /metrics
+        _san.record_wire_bytes("dist.allreduce", sig, axes="worker")
     with _san.collective_dispatch("dist.allreduce", sig=sig,
                                   axes="worker"):
         if _tel._enabled:

@@ -106,7 +106,8 @@ __all__ = ["SanitizerError", "SanitizerWarning", "arm", "disarm", "armed",
            "caches", "stats", "violations", "reset", "note_collective",
            "collective_dispatch", "collective_sync", "collective_sig",
            "allow_thread_collective", "ledger_tail", "collective_state",
-           "expect_recompile"]
+           "expect_recompile", "sig_nbytes", "record_wire_bytes",
+           "wire_bytes"]
 
 CHECKERS = ("recompile", "sync", "donate", "collective")
 
@@ -161,6 +162,8 @@ _stats = {"recompile_violations": 0, "sync_violations": 0,
           "sync_allowed": 0, "cache_misses": 0, "raw_compiles": 0,
           "collective_dispatches": 0, "collective_thread_allowed": 0}
 _violations = deque(maxlen=200)
+_wire_bytes = {}          # (kind, axes) -> cumulative payload bytes folded
+                          # out of dispatch signatures (record_wire_bytes)
 _tls = threading.local()
 _log_handler = None       # compile-log watcher state
 _log_prev_level = None
@@ -194,6 +197,17 @@ def _violation(checker, message, raise_ok=True, quiet=False):
     if _tel._enabled:
         _tel.counter("san_violations", checker=checker)
     if _mode == "raise" and raise_ok:
+        if _tel.flight_recorder_armed():
+            # the raise is about to unwind the run: leave the crash ring
+            # behind first (MXNET_FLIGHT_RECORDER contract — every fatal
+            # path flushes the last-N-events timeline into a bundle)
+            try:
+                from . import diagnostics as _diag
+                _diag.write_snapshot("sanitizer_violation",
+                                     extra={"checker": checker,
+                                            "violation": message})
+            except Exception:   # noqa: BLE001 — never mask the violation
+                pass
         raise SanitizerError(message)
     if not quiet:
         warnings.warn(message, SanitizerWarning, stacklevel=3)
@@ -665,6 +679,75 @@ def collective_sig(arrays):
         shape = tuple(getattr(a, "shape", ()))
         out.append("%s(%s)" % (dt, ",".join(str(d) for d in shape)))
     return tuple(out)
+
+
+# itemsizes for the collective_sig dtype abbreviations (plus the raw
+# numpy names a non-mapped dtype falls through as)
+_SIG_ITEMSIZE = {
+    "f64": 8, "i64": 8, "u64": 8, "c64": 8,
+    "f32": 4, "i32": 4, "u32": 4,
+    "bf16": 2, "f16": 2, "i16": 2, "u16": 2,
+    "i8": 1, "u8": 1, "b1": 1,
+    "int16": 2, "uint16": 2, "int8": 1, "uint8": 1,
+}
+
+
+def sig_nbytes(sig):
+    """Payload bytes of a :func:`collective_sig` tuple — the same
+    metadata-only arithmetic, run in reverse: ``("f32(8,4)", "i32(2)")``
+    -> 136.  Parts that are not shape/dtype-formed (a barrier's ``None``
+    sig, historical free-text sigs) contribute 0, so the accounting can
+    never raise or sync on an exotic dispatch."""
+    total = 0
+    for part in sig or ():
+        if not isinstance(part, str):
+            continue
+        dt, sep, rest = part.partition("(")
+        if not sep or not rest.endswith(")"):
+            continue
+        itemsize = _SIG_ITEMSIZE.get(dt)
+        if itemsize is None:
+            continue
+        elems = 1
+        try:
+            for d in rest[:-1].split(","):
+                d = d.strip()
+                if d:
+                    elems *= int(d)
+        except ValueError:
+            continue
+        total += itemsize * elems
+    return total
+
+
+def record_wire_bytes(kind, sig=None, axes=None, nbytes=None):
+    """Fold one collective dispatch's payload into the per-(kind, axes)
+    wire-bytes ledger.  ``nbytes`` overrides the sig arithmetic for sites
+    whose ledger sig is not shape-typed (the ZeRO gather's ``"%d
+    tensors"``).  Emits the ``coll_wire_bytes[kind/axes]`` telemetry
+    counter while recording.  Call sites gate on ``if _san._collective_on
+    or _tel._enabled:`` — with both off this is never reached, so the
+    accounting keeps the strict zero-overhead contract."""
+    if nbytes is None:
+        nbytes = sig_nbytes(sig)
+    nbytes = int(nbytes)
+    if nbytes <= 0:
+        return 0
+    key = (kind, axes if axes is not None else "-")
+    with _lock:
+        _wire_bytes[key] = _wire_bytes.get(key, 0) + nbytes
+    if _tel._enabled:
+        _tel.counter("coll_wire_bytes[%s/%s]" % key, nbytes)
+    return nbytes
+
+
+def wire_bytes():
+    """Snapshot of cumulative collective payload bytes:
+    ``{"kind/axes": bytes}`` (``-`` for axis-less dispatches).  Exposed to
+    users as ``dist.wire_bytes()``; the per-key telemetry counters carry
+    the same totals onto ``/metrics``."""
+    with _lock:
+        return {"%s/%s" % k: v for k, v in sorted(_wire_bytes.items())}
 
 
 def note_collective(kind, name=None, sig=None, axes=None, device=True):
@@ -1327,6 +1410,7 @@ def reset():
         for k in _stats:
             _stats[k] = 0
         _violations.clear()
+        _wire_bytes.clear()
         _DONATED.clear()
         _RAW_COMPILES.clear()
         _coll_ledger.clear()

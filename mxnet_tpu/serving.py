@@ -47,6 +47,7 @@ ever do.
 """
 from __future__ import annotations
 
+import contextlib as _contextlib
 import json
 import math as _math
 import queue as _queue_mod
@@ -417,8 +418,13 @@ class ServedModel(object):
                 _tel.gauge("serve_batch_size", n, model=self.name)
                 _tel.gauge("serve_queue_depth", self._queue.qsize(),
                            model=self.name)
-            with _tel.span("serve.batch", cat="serve", model=self.name,
-                           bucket=bucket, n=n):
+                # built under the gate (TEL001): span() no-ops when
+                # disabled, but the tag dict would still be paid per tick
+                batch_span = _tel.span("serve.batch", cat="serve",
+                                       model=self.name, bucket=bucket, n=n)
+            else:
+                batch_span = _contextlib.nullcontext()
+            with batch_span:
                 pred = self._predictor(bucket)
                 padded = {}
                 for k, shape in self._sample_shapes.items():

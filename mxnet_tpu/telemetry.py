@@ -38,6 +38,18 @@ so they do not even build the kwargs dict.
 Enable programmatically with ``start(path)`` / ``stop()``, or for a whole
 process with ``MXNET_TELEMETRY=<path.jsonl>`` (autostart at import, flush
 at exit — the env-var analogue of ``MXNET_PROFILER_AUTOSTART``).
+
+Flight recorder: ``MXNET_FLIGHT_RECORDER=N`` arms a bounded in-memory
+ring of the last N closed events (spans / counter deltas / scalars —
+shape/time metadata only) WITHOUT a file sink, threads, or device syncs.
+The hot-path call sites light up (``_enabled`` goes True) but
+``enabled()`` stays False so nothing that keys a behaviour change on
+"full telemetry" (the Module.fit fused-path downgrade, ``scalar_due``
+device syncs, file export) reacts.  The ring's only consumer is the
+diagnostics bundle: a crash, fatal signal, sanitizer ``:raise``
+violation, or watchdog stall dump carries the last ~N events of
+timeline without anyone having pre-armed full telemetry
+(docs/observability.md).
 """
 from __future__ import annotations
 
@@ -54,7 +66,8 @@ __all__ = ["start", "stop", "enabled", "span", "record_span", "counter",
            "gauge", "histogram", "scalar", "scalar_due", "value",
            "counters", "gauges", "histograms", "scalars", "quantile",
            "quantile_from_hist", "hist_bound", "events", "recent_events",
-           "flush", "reset", "sink_path"]
+           "flush", "reset", "sink_path", "flight_recorder",
+           "flight_recorder_armed"]
 
 _lock = threading.RLock()
 _enabled = False
@@ -71,11 +84,24 @@ _BUFFER_CAP = 262144  # in-memory mode: drop oldest beyond this
 _RECENT_CAP = 512     # event-stream tail kept past flushes (diagnostics)
 _recent = deque(maxlen=_RECENT_CAP)
 _dropped = 0
+# Flight recorder (MXNET_FLIGHT_RECORDER=N): a bounded ring of the last N
+# events, fed by _emit_locked whenever armed.  In *fr-only* mode (_enabled
+# True purely because the recorder armed it) events go ONLY to the ring —
+# no buffer growth, no file sink, no _recent churn — and enabled() stays
+# False so behaviour keyed on "full telemetry" (fused-path downgrade,
+# scalar_due syncs) does not change.
+_fr_ring = None       # deque(maxlen=_fr_cap) while armed, else None
+_fr_cap = 0
+_fr_only = False
 
 
 def enabled():
-    """True while the registry is recording."""
-    return _enabled
+    """True while the registry is recording a FULL session (``start()`` /
+    ``MXNET_TELEMETRY``).  Deliberately False in flight-recorder-only mode:
+    call sites that key behaviour — not just emission — on telemetry (the
+    Module.fit fused-path downgrade, per-step device syncs) must not react
+    to a crash ring that promises zero overhead."""
+    return _enabled and not _fr_only
 
 
 def start(path=None):
@@ -83,7 +109,7 @@ def start(path=None):
     sink; without it events stay in memory (``events()``), capped at
     ``_BUFFER_CAP``.  Any state left by a previous session (buffered
     events, counter totals) is cleared — one session per file."""
-    global _enabled, _path, _atexit_armed, _dropped, _scalars_every
+    global _enabled, _path, _atexit_armed, _dropped, _scalars_every, _fr_only
     with _lock:
         if path:
             open(path, "w").close()   # truncate: one run per file
@@ -93,7 +119,10 @@ def start(path=None):
         _gauges.clear()
         _histograms.clear()
         _scalars.clear()
+        if _fr_ring is not None:
+            _fr_ring.clear()
         _dropped = 0
+        _fr_only = False   # the recorder keeps riding along under a session
         _path = path
         try:
             _scalars_every = max(1, int(get_env("MXNET_SCALARS_EVERY", 1)))
@@ -111,10 +140,12 @@ def start(path=None):
 
 def stop():
     """Stop recording: emit a summary event (final counter/gauge values),
-    flush any file sink, and disable.  Idempotent."""
-    global _enabled
+    flush any file sink, and disable.  Idempotent.  While the flight
+    recorder is armed the registry drops back to fr-only mode instead of
+    fully disabling — the crash ring keeps recording."""
+    global _enabled, _path, _fr_only
     with _lock:
-        if not _enabled:
+        if not _enabled or _fr_only:
             return
         summary = {"type": "summary", "ts": time.time() * 1e6,
                    "counters": dict(_counters), "gauges": dict(_gauges)}
@@ -129,8 +160,13 @@ def stop():
             # in-memory cap evicted the run's oldest events — say so
             summary["dropped_events"] = _dropped
         _buffer.append(summary)
-        _enabled = False
-        _flush_locked()
+        if _fr_ring is not None:
+            _flush_locked()
+            _path = None
+            _fr_only = True
+        else:
+            _enabled = False
+            _flush_locked()
 
 
 def reset():
@@ -143,6 +179,8 @@ def reset():
         _gauges.clear()
         _histograms.clear()
         _scalars.clear()
+        if _fr_ring is not None:
+            _fr_ring.clear()
         _dropped = 0
 
 
@@ -158,6 +196,10 @@ def sink_path():
 
 def _emit_locked(ev):
     global _dropped
+    if _fr_ring is not None:
+        _fr_ring.append(ev)      # bounded: deque(maxlen) evicts the oldest
+        if _fr_only:
+            return               # fr-only: the ring is the ONLY sink
     _buffer.append(ev)
     _recent.append(ev)
     if _path is not None:
@@ -403,8 +445,9 @@ def scalar_due(step):
     bounds syncs, not just file volume.  Producers with their own cadence
     (Speedometer ``frequent``, Monitor ``interval``, epoch-end rollups,
     lr decay boundaries) emit directly — decimating those would drop the
-    few points that matter most."""
-    return _enabled and int(step) % _scalars_every == 0
+    few points that matter most.  Always False in flight-recorder-only
+    mode: the crash ring must never buy a device sync."""
+    return _enabled and not _fr_only and int(step) % _scalars_every == 0
 
 
 def scalar(name, step, value, **tags):
@@ -587,6 +630,87 @@ def span(name, cat="runtime", mirror=True, **tags):
     return _Span(name, cat, tags, mirror)
 
 
+# ------------------------------------------------------- flight recorder
+def flight_recorder_armed():
+    """True while the crash ring (``MXNET_FLIGHT_RECORDER=N``) is armed."""
+    return _fr_ring is not None
+
+
+def flight_recorder():
+    """Snapshot of the flight-recorder ring for a diagnostics bundle, or
+    None while disarmed: capacity, the ring contents (oldest first), and
+    the last completed step derived from them — ``last_step`` is the tag
+    dict of the newest closed ``step`` span (epoch/nbatch), and
+    ``last_scalar_step`` the newest scalar event's global step, so a crash
+    report names where each rank got to without replaying the ring."""
+    with _lock:
+        if _fr_ring is None:
+            return None
+        evs = list(_fr_ring)
+    last_step = None
+    last_scalar_step = None
+    for ev in reversed(evs):
+        t = ev.get("type")
+        if last_step is None and t == "span" and ev.get("name") == "step":
+            last_step = dict(ev.get("tags") or {})
+        if last_scalar_step is None and t == "scalar":
+            last_scalar_step = ev.get("step")
+        if last_step is not None and last_scalar_step is not None:
+            break
+    return {"capacity": _fr_cap, "recorded": len(evs),
+            "last_step": last_step, "last_scalar_step": last_scalar_step,
+            "events": evs}
+
+
+def _fr_arm(capacity):
+    """Arm the flight recorder with a ring of ``capacity`` events.  Flips
+    the registry into fr-only mode unless a full session is already
+    recording (then the ring simply rides along)."""
+    global _enabled, _fr_ring, _fr_cap, _fr_only
+    capacity = int(capacity)
+    if capacity <= 0:
+        raise ValueError("flight recorder capacity must be > 0 "
+                         "(got %d)" % capacity)
+    with _lock:
+        _fr_cap = capacity
+        _fr_ring = deque(_fr_ring or (), maxlen=capacity)
+        if not _enabled:
+            _fr_only = True
+            _enabled = True
+
+
+def _fr_disarm():
+    """Disarm the recorder and drop the ring (test helper)."""
+    global _enabled, _fr_ring, _fr_cap, _fr_only
+    with _lock:
+        _fr_ring = None
+        _fr_cap = 0
+        if _fr_only:
+            _fr_only = False
+            _enabled = False
+
+
+def _fr_autostart():
+    """MXNET_FLIGHT_RECORDER=N arms the crash ring at import time.  No
+    threads, no file, no atexit — the ring only surfaces through the
+    diagnostics bundle.  A malformed or non-positive value degrades to
+    disarmed-with-a-warning rather than failing the import."""
+    raw = get_env("MXNET_FLIGHT_RECORDER")
+    if raw is None or raw == "" or str(raw) == "0":
+        return False
+    try:
+        cap = int(raw)
+        if cap <= 0:
+            raise ValueError(raw)
+        _fr_arm(cap)
+    except (TypeError, ValueError):
+        import warnings
+        warnings.warn("MXNET_FLIGHT_RECORDER=%r is not a positive integer; "
+                      "flight recorder disarmed" % (raw,))
+        return False
+    return True
+
+
 # ------------------------------------------------- autostart (env contract)
 def _autostart():
     """MXNET_TELEMETRY=<path.jsonl> starts recording at import time.  In a
@@ -611,3 +735,4 @@ def _autostart():
 
 
 _autostart()
+_fr_autostart()

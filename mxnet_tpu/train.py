@@ -697,6 +697,13 @@ class TrainStep(object):
             _san.note_collective(
                 "mxtpu_zero_gather", name="params",
                 sig=("%d tensors" % len(params),), axes="dp")
+        if _san._collective_on or _tel._enabled:
+            # the ledger sig above is not shape-typed; the gathered
+            # payload is the full logical parameter set — account it
+            # explicitly (shape metadata only, no sync)
+            _san.record_wire_bytes(
+                "mxtpu_zero_gather", axes="dp",
+                nbytes=sum(_tel.nbytes_of(v) for v in params.values()))
         if _tel._enabled:
             with _tel.span("zero.gather", cat="distributed",
                            level=self.zero, tensors=len(params)):
@@ -2239,15 +2246,19 @@ class PipelineTrainStep(object):
                         # bucketed gradient all-gather NOW, so the dp
                         # collective overlaps the other slices' remaining
                         # compute instead of waiting inside the update
-                        if _san._collective_on:
-                            # ledger entry at dispatch, from the bucket's
-                            # shape metadata (no sync): a rank whose
-                            # schedule diverges is named by stage + sig
-                            # at the next hash-chain exchange
-                            _san.note_collective(
-                                "mxtpu_pp_gather", name="stage%d" % k,
-                                sig=_san.collective_sig((acc[k],)),
-                                axes="dp")
+                        if _san._collective_on or _tel._enabled:
+                            gsig = _san.collective_sig((acc[k],))
+                            _san.record_wire_bytes("mxtpu_pp_gather",
+                                                   gsig, axes="dp")
+                            if _san._collective_on:
+                                # ledger entry at dispatch, from the
+                                # bucket's shape metadata (no sync): a
+                                # rank whose schedule diverges is named
+                                # by stage + sig at the next hash-chain
+                                # exchange
+                                _san.note_collective(
+                                    "mxtpu_pp_gather", name="stage%d" % k,
+                                    sig=gsig, axes="dp")
                         grads_full[k] = self._timed(
                             busy, d, self._get_prog("gather", k),
                             p_s[k], acc[k])

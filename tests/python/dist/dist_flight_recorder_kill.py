@@ -1,0 +1,73 @@
+"""2-process flight-recorder kill e2e: rank 1 SIGTERMs itself mid-epoch
+(the launcher/scheduler-kills-one-rank shape) and must leave a
+``fatal_signal`` diagnostics bundle whose flight-recorder section names
+the last completed step; rank 0 is torn down by the launcher and leaves
+its flushed telemetry JSONL behind.  Clock samples are exchanged at the
+per-epoch barrier so ``tools/trace_merge.py`` can offset-correct both
+ranks' dumps into one fleet timeline.
+
+Run via the launcher (the wrapping test sets the env):
+    JAX_PLATFORMS=cpu MXNET_TELEMETRY=... MXNET_FLIGHT_RECORDER=512 \
+        MXNET_DIAG_DIR=... python tools/launch.py -n 2 \
+        python tests/python/dist/dist_flight_recorder_kill.py
+"""
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+from mxnet_tpu.parallel import dist  # noqa: E402
+
+dist.init_process_group()
+
+import numpy as np  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import telemetry as tel  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+# batch_end_callback runs BEFORE the step span closes, so killing at
+# (epoch 2, nbatch 2) leaves (2, 1) as the last step the ring recorded
+KILL_AT = (2, 2)
+
+
+def main():
+    assert tel.flight_recorder_armed(), "wrapping test must arm the ring"
+    rank, world = dist.rank(), dist.num_workers()
+    rng = np.random.RandomState(0)  # same on every worker
+    n, nc, dim = 200, 4, 16
+    centers = rng.randn(nc, dim) * 3
+    y = rng.randint(0, nc, n)
+    x = (centers[y] + rng.randn(n, dim)).astype(np.float32)
+    shard = slice(rank * n // world, (rank + 1) * n // world)
+    it = mx.io.NDArrayIter(x[shard], y[shard].astype(np.float32),
+                           batch_size=25)
+
+    def batch_cb(param):
+        if param.nbatch == 0:
+            # one clock sample per epoch — ranks are in lockstep through
+            # the kvstore all-reduce, so the barrier names pair up
+            dist.barrier("fr-clock-%d" % param.epoch)
+        # survivors die by the launcher's SIGKILL when a peer drops:
+        # flush per batch so the stream on disk covers the whole run
+        tel.flush()
+        if rank == 1 and (param.epoch, param.nbatch) == KILL_AT:
+            # the SIGTERM handler writes the fatal_signal bundle, then
+            # re-delivers the signal with the default disposition, so
+            # this call never returns; the explicit exit is a backstop
+            # emulating the scheduler's follow-up kill
+            os.kill(os.getpid(), signal.SIGTERM)
+            os._exit(143)
+
+    mx.random.seed(7)
+    mod = mx.Module(models.get_mlp(num_classes=nc), context=mx.cpu())
+    mod.fit(it, num_epoch=6, kvstore="dist_tpu", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            batch_end_callback=batch_cb)
+    # unreachable in the intended run: rank 1 dies at KILL_AT and the
+    # launcher tears rank 0 down inside the stalled collective
+    print("OK rank %d" % rank)
+
+
+if __name__ == "__main__":
+    main()

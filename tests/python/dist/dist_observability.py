@@ -1,0 +1,79 @@
+"""2-process observability e2e under ``MXNET_SAN=all:raise``: the
+wrapping test sets ``MXNET_TELEMETRY``, so every barrier ENTRY exchanges
+one clock sample over the coordination service (key-value RPC only — the
+collective ledger and hash chain stay quiet) and every fused kvstore
+all-reduce folds its payload into the per-(kind, axes) wire-bytes
+counters.  The run must finish with ZERO sanitizer violations, a
+non-None per-rank clock-offset estimate, and a non-empty wire ledger —
+the machine-readable evidence rides one ``OBS rank`` line per rank.
+
+Run via the launcher (the wrapping test sets the env):
+    JAX_PLATFORMS=cpu MXNET_SAN=all:raise MXNET_TELEMETRY=/tmp/t.jsonl \
+        python tools/launch.py -n 2 \
+        python tests/python/dist/dist_observability.py
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+from mxnet_tpu.parallel import dist  # noqa: E402
+
+dist.init_process_group()
+
+import numpy as np  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sanitize as san  # noqa: E402
+from mxnet_tpu import telemetry as tel  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+
+def main():
+    assert san.armed() == frozenset(san.CHECKERS), san.armed()
+    assert tel.enabled(), "wrapping test must set MXNET_TELEMETRY"
+    rank, world = dist.rank(), dist.num_workers()
+    rng = np.random.RandomState(0)  # same on every worker
+    n, nc, dim = 200, 4, 16
+    centers = rng.randn(nc, dim) * 3
+    y = rng.randint(0, nc, n)
+    x = (centers[y] + rng.randn(n, dim)).astype(np.float32)
+    shard = slice(rank * n // world, (rank + 1) * n // world)
+    it = mx.io.NDArrayIter(x[shard], y[shard].astype(np.float32),
+                           batch_size=25)
+
+    mx.random.seed(7)  # identical init on every worker
+    mod = mx.Module(models.get_mlp(num_classes=nc), context=mx.cpu())
+    mod.fit(it, num_epoch=3, kvstore="dist_tpu", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+
+    # a few explicit barriers on top of the fit's own: each entry is one
+    # more clock sample for the offset median (and one more hash-chain
+    # exchange for the collective checker)
+    for i in range(3):
+        dist.barrier("obs-extra-%d" % i)
+
+    off = dist.clock_offset()
+    assert off is not None, "clock exchange never produced an estimate"
+    if rank == 0:
+        assert off == 0.0, off  # rank 0 IS the reference clock
+
+    wires = dist.wire_bytes()
+    assert wires.get("dist.allreduce/worker", 0) > 0, wires
+
+    # clean under all:raise — and the clock exchange stayed off the
+    # collective ledger (KV RPC only), so the chain verified end to end
+    s = san.stats()
+    for k in ("collective_violations", "sync_violations",
+              "donate_violations", "recompile_violations"):
+        assert s[k] == 0, (k, s, san.violations())
+    st = san.collective_state()
+    assert st["exchanges"] > 0, "hash chain never exchanged"
+
+    print("OBS rank %d offset %.6f wire %s"
+          % (rank, off, json.dumps(wires)))
+    print("OK rank %d" % rank)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""TEL001 clean twin: every emission behind a gate, both sanctioned idioms."""
+from . import sanitize as _san
+from . import telemetry as _tel
+
+
+class TrainStep(object):
+    def __call__(self, params, batch):
+        loss, grads = self._step(params, batch)
+        if _tel._enabled:
+            _tel.counter("train_steps")
+            _tel.gauge("loss_scale", self.scale)
+            with _tel.span("train_step", cat="executor"):
+                res = self._finish(loss, grads)
+        else:
+            res = self._finish(loss, grads)
+        return res
+
+
+class EvalStep(object):
+    def __call__(self, params, batch):
+        # the dominating early-return idiom (executor.forward/backward)
+        if not _tel._enabled:
+            return self._fwd(params, batch)
+        out = self._fwd(params, batch)
+        _tel.scalar("val_loss", self.step, 0.0)
+        return out
+
+
+def gather_params(params, plan):
+    if _san._collective_on or _tel._enabled:
+        _san.record_wire_bytes("mxtpu_zero_gather", axes="dp",
+                               nbytes=sum(plan.values()))
+    return params

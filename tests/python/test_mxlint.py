@@ -42,6 +42,7 @@ CASES = [
     ("coll", "COLL001", ("pkg",), "rank-dependent"),
     ("coll2", "COLL002", ("pkg",), "single-use"),
     ("thr2", "THR002", ("pkg",), "off-main-thread"),
+    ("tel", "TEL001", ("mxnet_tpu",), "unguarded telemetry emission"),
 ]
 
 

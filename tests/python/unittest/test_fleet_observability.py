@@ -607,3 +607,315 @@ print("OK rank", rank)
     assert merged["histograms"]["step"]["count"] > 0
     rep = merged["skew"]["step"]
     assert rep["slowest_rank"] == 1 and rep["straggler"] == 1
+
+
+# ----------------------------------------------------- fleet trace timeline
+def _span_ev(name, ts_us, dur_us, cat="step", **tags):
+    ev = {"type": "span", "name": name, "cat": cat,
+          "ts": ts_us, "dur": dur_us}
+    if tags:
+        ev["tags"] = dict(tags)
+    return ev
+
+
+def test_trace_merge_corrects_known_skew(tmp_path):
+    """Two synthetic rank streams with a KNOWN 3.5 s wall-clock skew:
+    the merged chrome trace lands the simultaneous step on the same
+    corrected timestamp, one track per rank, tags preserved."""
+    tm = _load_tool("trace_merge")
+    skew = 3.5
+    t0 = 1_000_000_000.0    # µs
+    r0 = [
+        _span_ev("step", t0, 10_000.0, epoch=0, nbatch=0),
+        {"type": "counter", "name": "fit_samples",
+         "ts": t0 + 10_000.0, "total": 10},
+        {"type": "gauge", "name": "clock_offset_sec",
+         "ts": t0 + 11_000.0, "value": 0.0},
+    ]
+    r1 = [
+        _span_ev("step", t0 + skew * 1e6, 14_000.0, epoch=0, nbatch=0),
+        {"type": "gauge", "name": "clock_offset_sec",
+         "ts": t0 + skew * 1e6 + 15_000.0, "value": skew},
+    ]
+    base = str(tmp_path / "t.jsonl")
+    for rank, evs in ((0, r0), (1, r1)):
+        with open("%s.rank%d" % (base, rank), "w") as f:
+            for ev in evs:
+                f.write(json.dumps(ev) + "\n")
+    doc, notes = tm.merge_paths([base + ".rank0", base + ".rank1"])
+    assert [n["rank"] for n in notes] == [0, 1]
+    assert all(n["corrected"] for n in notes), notes
+    assert notes[1]["offset_sec"] == pytest.approx(skew)
+    evs = doc["traceEvents"]
+    assert doc["displayTimeUnit"] == "ms"
+    names = {e["pid"]: e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == {0: "rank 0", 1: "rank 1"}
+    spans = {e["pid"]: e for e in evs if e["ph"] == "X"}
+    # offset correction: the skewed rank's step lands on the SAME
+    # corrected timestamp as rank 0's
+    assert spans[0]["ts"] == pytest.approx(t0)
+    assert spans[1]["ts"] == pytest.approx(t0)
+    assert spans[1]["args"] == {"epoch": 0, "nbatch": 0}
+    assert {c["name"] for c in evs if c["ph"] == "C"} == {"fit_samples",
+                                                          "clock_offset_sec"}
+    # events are time-sorted (chrome-trace loaders expect it)
+    ts = [e.get("ts", 0.0) for e in evs]
+    assert ts == sorted(ts)
+    # CLI round trip: ONE base path expands .rank*, the output file is
+    # loadable JSON carrying the same events
+    out = tmp_path / "fleet.trace.json"
+    assert tm.main([base, "-o", str(out)]) == 0
+    assert json.loads(out.read_text()) == doc
+
+
+def test_trace_merge_mixes_bundle_and_jsonl(tmp_path):
+    """A crash bundle (the flight-recorder ring) and a live JSONL merge
+    into one timeline; a stream without clock_offset_sec merges
+    uncorrected with a note instead of failing."""
+    tm = _load_tool("trace_merge")
+    base = str(tmp_path / "t.jsonl")
+    with open(base + ".rank0", "w") as f:
+        f.write(json.dumps(_span_ev("step", 5e8, 9_000.0,
+                                    epoch=1, nbatch=3)) + "\n")
+    bundle = {
+        "type": "mxtpu_diagnostics", "reason": "fatal_signal", "rank": "1",
+        "flight_recorder": {
+            "capacity": 64, "recorded": 1,
+            "last_step": {"epoch": 1, "nbatch": 2},
+            "events": [_span_ev("step", 5e8 + 2e6, 12_000.0,
+                                epoch=1, nbatch=2)]},
+    }
+    bpath = tmp_path / "mxtpu_diag.fatal_signal.pid7.rank1.json"
+    bpath.write_text(json.dumps(bundle, indent=1) + "\n")
+    doc, notes = tm.merge_paths([base + ".rank0", str(bpath)])
+    by_rank = {n["rank"]: n for n in notes}
+    assert by_rank[0]["source"] == "jsonl"
+    assert by_rank[1]["source"] == "bundle"
+    assert not by_rank[0]["corrected"] and not by_rank[1]["corrected"]
+    names = {e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names[0] == "rank 0 (uncorrected clock)"
+    assert names[1] == "rank 1 (uncorrected clock)"
+    spans = {e["pid"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert spans[1]["args"] == {"epoch": 1, "nbatch": 2}
+
+
+def test_step_anatomy_names_rank_and_phase(tmp_path, capsys):
+    """The step-anatomy verdict names the straggler rank AND the phase
+    responsible — all of rank 1's 4 ms excess sits in the comm family
+    (nested inside the compute span, so compute stays exclusive)."""
+    agg = _load_tool("telemetry_agg")
+    base = str(tmp_path / "t.jsonl")
+    for rank, (step_ms, comm_ms) in {0: (10.0, 2.0), 1: (14.0, 6.0)}.items():
+        tel.start("%s.rank%d" % (base, rank))
+        t = time.time()
+        for i in range(30):
+            tel.record_span("step", t, step_ms / 1e3, cat="step",
+                            epoch=0, nbatch=i, mirror=False)
+            tel.record_span("data_wait", t, 1.0 / 1e3, cat="step",
+                            mirror=False)
+            # comm nests INSIDE the fused compute span (the kvstore
+            # allreduce runs inside update)
+            tel.record_span("fused_step", t, (step_ms - 1.0) / 1e3,
+                            cat="step", mirror=False)
+            tel.record_span("dist.allreduce", t, comm_ms / 1e3, cat="comm",
+                            mirror=False)
+        tel.stop()
+    merged = agg.aggregate(agg.rank_files(base))
+    an = merged["anatomy"]
+    assert an["slowest_rank"] == 1 and an["straggler"] == 1
+    assert an["skew_ratio"] == pytest.approx(1.4, rel=0.01)
+    assert an["slow_phase"] == "comm"
+    assert an["slow_phase_excess_ms"] == pytest.approx(4.0, rel=0.01)
+    r0, r1 = an["ranks"][0], an["ranks"][1]
+    assert r1["comm_ms"] == pytest.approx(6.0, rel=0.01)
+    # compute exclusive of the nested comm span: identical across ranks
+    assert r1["compute_ms"] == pytest.approx(r0["compute_ms"], rel=0.01)
+    # the rendered table carries the same verdict, naming rank AND phase
+    assert agg.main([base]) == 0
+    out = capsys.readouterr().out
+    assert "Step anatomy" in out
+    assert "slowest rank: 1" in out
+    assert "dominated by comm" in out and "STRAGGLER" in out
+    # and the --json doc carries the anatomy block for machines
+    assert agg.main([base, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["anatomy"]["slow_phase"] == "comm"
+
+
+# --------------------------------------------------- wire-bytes accounting
+def test_hlo_wire_bytes_from_synthetic_hlo():
+    """The dryrun's HLO wire-bytes parser: result-shape payloads per
+    collective kind, sync and async (``-start``) forms, ignoring
+    non-collective lines."""
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", ROOT / "__graft_entry__.py")
+    ge = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ge)
+    hlo = "\n".join([
+        "  %ar = f32[128,256] all-reduce(f32[128,256] %p0), to_apply=%add",
+        "  %ar2 = f32[64]{0} all-reduce-start(f32[64] %p1)",
+        "  %rs = bf16[32,8] reduce-scatter(bf16[256,8] %x), dimensions={0}",
+        "  %ag = f32[1024] all-gather(f32[128] %y), dimensions={0}",
+        "  %noise = f32[999] add(f32[999] %a, f32[999] %b)",
+    ])
+    w = ge.hlo_wire_bytes(hlo)
+    assert w["all-reduce"] == 128 * 256 * 4 + 64 * 4
+    assert w["reduce-scatter"] == 32 * 8 * 2
+    assert w["all-gather"] == 1024 * 4
+    assert "all-to-all" not in w
+    assert ge.hlo_wire_bytes("no collectives here") == {}
+
+
+def test_run_compare_gates_wire_bytes_regression(tmp_path):
+    """run_compare ingests the dryrun's `wire_bytes` block: per-kind
+    payload metrics gate through the wire_bytes down-hint (bytes on the
+    wire regress by going UP), the config block is identity, and the
+    committed MULTICHIP_WIRE_r01.json self-compares rc=0."""
+    from tools import run_compare as rc
+
+    def record(ar_mb, zero_ar_mb, devices=8):
+        return {"metric": "wire_bytes_all_reduce_mb", "value": ar_mb,
+                "unit": "mb",
+                "wire_bytes": {"wire_bytes_all_reduce_mb": ar_mb,
+                               "zero_wire_bytes_all_reduce_mb": zero_ar_mb,
+                               "config": {"devices": devices,
+                                          "per_device_batch": 2}}}
+
+    base = tmp_path / "a.json"
+    base.write_text(json.dumps(record(90.0, 30.0)))
+    same = tmp_path / "b.json"
+    same.write_text(json.dumps(record(90.0, 30.0)))
+    worse = tmp_path / "c.json"
+    worse.write_text(json.dumps(record(135.0, 30.0)))
+    other = tmp_path / "d.json"
+    other.write_text(json.dumps(record(45.0, 15.0, devices=4)))
+    assert rc.main([str(base), str(same), "--check"]) == 0
+    # payload bytes going UP is a REGRESSION (the wire_bytes down-hint)
+    assert rc.main([str(base), str(worse), "--check"]) == 2
+    # a different mesh is a different experiment, not a regression pair
+    assert rc.main([str(base), str(other), "--check"]) == 0
+    run = rc.load_run(str(base))
+    assert run.bench["wire_bytes_all_reduce_mb"] == pytest.approx(90.0)
+    assert "config" not in run.bench
+    committed = ROOT / "MULTICHIP_WIRE_r01.json"
+    assert committed.exists(), "committed wire record missing"
+    assert rc.main([str(committed), str(committed), "--check"]) == 0
+    rec = rc.load_run(str(committed))
+    assert rec.bench["wire_bytes_all_reduce_mb"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(300)
+def test_dist_observability_clean_timeline_and_wire_bytes(tmp_path):
+    """The fleet-timeline acceptance: a 2-process dist fit under
+    ``MXNET_SAN=all:raise`` exchanges clock samples at barrier entries
+    (KV RPC only — zero ledger violations), accounts the kvstore
+    all-reduce payload in ``dist.wire_bytes()``, and the per-rank
+    telemetry streams merge into one offset-corrected chrome trace."""
+    import re
+    import subprocess
+    import sys
+    tm = _load_tool("trace_merge")
+    tfile = str(tmp_path / "t.jsonl")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_SAN"] = "all:raise"
+    env["MXNET_TELEMETRY"] = tfile
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "launch.py"), "-n", "2",
+         sys.executable, str(ROOT / "tests" / "python" / "dist" /
+                             "dist_observability.py")],
+        env=env, cwd=str(ROOT), capture_output=True, text=True, timeout=280)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    assert out.count("OK rank") == 2, out[-3000:]
+    # every rank accounted the kvstore all-reduce payload
+    obs = dict(re.findall(r"OBS rank (\d) offset \S+ wire (.*)",
+                          proc.stdout))
+    assert set(obs) == {"0", "1"}
+    for rank, wire_json in obs.items():
+        wires = json.loads(wire_json)
+        assert wires["dist.allreduce/worker"] > 0, (rank, wires)
+    # the per-rank streams carry the clock estimate and merge corrected
+    files = [tfile + ".rank0", tfile + ".rank1"]
+    for f in files:
+        assert os.path.exists(f), os.listdir(str(tmp_path))
+    doc, notes = tm.merge_paths(files)
+    assert [n["rank"] for n in notes] == [0, 1]
+    assert all(n["corrected"] for n in notes), notes
+    assert notes[0]["offset_sec"] == 0.0   # rank 0 IS the reference
+    names = {e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == {0: "rank 0", 1: "rank 1"}
+    span_pids = {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert span_pids == {0, 1}
+    # the wire-bytes counters rode the same streams onto the timeline
+    wire_tracks = {e["name"] for e in doc["traceEvents"]
+                   if e["ph"] == "C" and "coll_wire_bytes" in e["name"]}
+    assert any("dist.allreduce/worker" in n for n in wire_tracks), \
+        wire_tracks
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(300)
+def test_flight_recorder_kill_rank_e2e(tmp_path):
+    """THE flight-recorder acceptance: a 2-process launch with the ring
+    armed, rank 1 killed mid-epoch → its ``fatal_signal`` bundle names
+    the last completed step; trace_merge over rank 1's bundle + rank 0's
+    flushed JSONL yields ONE Perfetto-loadable timeline with
+    offset-corrected per-rank tracks."""
+    import glob
+    import subprocess
+    import sys
+    tm = _load_tool("trace_merge")
+    tfile = str(tmp_path / "t.jsonl")
+    diag = tmp_path / "diag"
+    diag.mkdir()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_TELEMETRY"] = tfile
+    env["MXNET_FLIGHT_RECORDER"] = "512"
+    env["MXNET_DIAG_DIR"] = str(diag)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "launch.py"), "-n", "2",
+         sys.executable, str(ROOT / "tests" / "python" / "dist" /
+                             "dist_flight_recorder_kill.py")],
+        env=env, cwd=str(ROOT), capture_output=True, text=True, timeout=280)
+    out = proc.stdout + proc.stderr
+    # the world died by design: the launcher saw rank 1's SIGTERM exit
+    # and tore rank 0 down
+    assert proc.returncode != 0, out[-3000:]
+    assert "OK rank 1" not in out
+    # rank 1 left its fatal_signal bundle, the ring flushed into it
+    bundles = glob.glob(str(diag / "mxtpu_diag.fatal_signal.*.rank1.json"))
+    assert len(bundles) == 1, os.listdir(str(diag))
+    doc = json.loads(open(bundles[0]).read())
+    assert doc["type"] == "mxtpu_diagnostics"
+    assert doc["reason"] == "fatal_signal"
+    assert doc["extra"]["signal_name"] == "SIGTERM"
+    fr = doc["flight_recorder"]
+    assert fr["capacity"] == 512 and fr["recorded"] > 0
+    # batch_end_callback killed at (2, 2) BEFORE that step span closed,
+    # so the last completed step the ring names is (2, 1)
+    assert fr["last_step"] == {"epoch": 2, "nbatch": 1}, fr["last_step"]
+    # the merged timeline: rank 0's flushed JSONL + rank 1's bundle,
+    # both offset-corrected from the per-epoch clock exchange
+    rank0 = tfile + ".rank0"
+    assert os.path.exists(rank0), os.listdir(str(tmp_path))
+    merged, notes = tm.merge_paths([rank0, bundles[0]])
+    by_rank = {n["rank"]: n for n in notes}
+    assert set(by_rank) == {0, 1}
+    assert by_rank[0]["source"] == "jsonl"
+    assert by_rank[1]["source"] == "bundle"
+    assert all(n["corrected"] for n in notes), notes
+    names = {e["pid"]: e["args"]["name"] for e in merged["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == {0: "rank 0", 1: "rank 1"}
+    span_pids = {e["pid"] for e in merged["traceEvents"] if e["ph"] == "X"}
+    assert span_pids == {0, 1}
+    # Perfetto-loadable: a plain JSON object with a traceEvents list
+    json.dumps(merged)

@@ -75,6 +75,31 @@ assert _san.collective_dispatch("barrier", name="probe") \
     is _san.hot_region("x"), "collective dispatch not the no-op singleton"
 assert _san.collective_state()["seq"] == 0, "ledger grew while disarmed"
 
+# flight recorder: with MXNET_FLIGHT_RECORDER unset there is no ring, no
+# telemetry session, and no crash hooks — sys.excepthook untouched and
+# no SIGTERM handler installed (diagnostics._fr_wire is a no-op)
+_tel = mxnet_tpu.telemetry
+assert _tel._fr_ring is None, "flight-recorder ring pre-created"
+assert _tel._fr_cap == 0 and _tel._fr_only is False, "fr state armed"
+assert _tel.flight_recorder_armed() is False, "flight recorder armed"
+assert _tel.flight_recorder() is None, "flight recorder has a dump"
+assert sys.excepthook is sys.__excepthook__, "excepthook chained"
+import signal
+assert signal.getsignal(signal.SIGTERM) is signal.SIG_DFL, \
+    "SIGTERM handler installed"
+
+# cross-rank clock exchange: no samples, no offset, no seq advancement
+# with telemetry (and the fr) off — dist's barrier entries never touch
+# the coordination service for clocks
+import mxnet_tpu.parallel.dist as _dist
+assert _dist._clock_seq == 0, "clock exchange advanced"
+assert _dist._clock_samples == [], "clock samples recorded"
+assert _dist.clock_offset() is None, "clock offset estimated"
+
+# wire-bytes accounting: the ledger starts empty and stays empty (the
+# dispatch-site gates are off)
+assert _san.wire_bytes() == {}, "wire-bytes ledger grew while disarmed"
+
 new_threads = [t.name for t in threading.enumerate()
                if t.ident not in baseline_threads]
 print("RESULT " + json.dumps({"threads": new_threads, **created}))
@@ -102,6 +127,61 @@ def test_import_with_env_unset_creates_no_resources(tmp_path):
     assert result["file"] == [], result
     assert result["process"] == [], result
     # and nothing appeared in the working directory either
+    assert list(tmp_path.iterdir()) == []
+
+
+_FR_CHILD = r"""
+import json, sys, threading, signal
+
+import jax                      # pre-load: jax's import cost is not ours
+
+baseline_threads = {t.ident for t in threading.enumerate()}
+
+import mxnet_tpu
+import mxnet_tpu.telemetry as _tel
+import mxnet_tpu.diagnostics
+
+# armed: the ring exists at the requested capacity and the crash hooks
+# are wired — but STILL zero threads (in-memory metadata only)
+assert _tel.flight_recorder_armed() is True, "not armed"
+assert _tel._fr_ring is not None and _tel._fr_ring.maxlen == 16
+assert _tel._fr_only is True, "fr must not open a full telemetry session"
+assert _tel.enabled() is False, "fr-only must not flip public enabled()"
+assert _tel.sink_path() is None, "fr-only mode opened a file sink"
+assert sys.excepthook is not sys.__excepthook__, "excepthook not chained"
+assert signal.getsignal(signal.SIGTERM) is not signal.SIG_DFL, \
+    "SIGTERM handler missing"
+fr = _tel.flight_recorder()
+assert fr["capacity"] == 16 and fr["recorded"] == 0, fr
+
+new = [t.name for t in threading.enumerate()
+       if t.ident not in baseline_threads]
+print("RESULT " + json.dumps({"threads": new}))
+"""
+
+
+@pytest.mark.timeout(180)
+def test_flight_recorder_armed_rings_without_threads(tmp_path):
+    """MXNET_FLIGHT_RECORDER arms the ring + crash hooks but keeps the
+    rest of the no-op contract: no threads, no file sink, and the public
+    ``enabled()`` (the fused-path selector) stays False."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("MXNET_", "MXTPU_"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_FLIGHT_RECORDER"] = "16"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in (env.get("PYTHONPATH"),) if p]
+        + [os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))])
+    proc = subprocess.run(
+        [sys.executable, "-B", "-c", _FR_CHILD], cwd=str(tmp_path),
+        env=env, capture_output=True, text=True, timeout=150)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")]
+    assert line, proc.stdout + proc.stderr
+    result = json.loads(line[-1][len("RESULT "):])
+    assert result["threads"] == [], result
+    # armed but idle: nothing lands in the working directory either
     assert list(tmp_path.iterdir()) == []
 
 

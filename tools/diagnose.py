@@ -15,6 +15,8 @@ This tool renders the forensic content for humans:
 * every Python thread's stack at dump time,
 * the live-resize trajectory (elasticity v3: world-size history, last
   membership transition, lost-step count) when the process resized,
+* the flight-recorder ring (``MXNET_FLIGHT_RECORDER=N``: the last N
+  events before the incident, plus the last completed step they imply),
 * the telemetry counter/gauge snapshot,
 * the tail of the telemetry event stream (what the run did just before).
 
@@ -138,6 +140,31 @@ def render(bundle, out=sys.stdout, events=10, stacks=True):
                          _fmt_ts(last.get("time")), last.get("epoch"),
                          last.get("nbatch"), last.get("step"),
                          last.get("seconds")))
+
+    fr = bundle.get("flight_recorder")
+    if fr:
+        out.write("\nFlight recorder (ring of %s, %s recorded)\n"
+                  % (fr.get("capacity"), fr.get("recorded")))
+        last = fr.get("last_step")
+        if last:
+            out.write("  last step    %s\n"
+                      % "  ".join("%s=%s" % (k, v)
+                                  for k, v in sorted(last.items())))
+        if fr.get("last_scalar_step") is not None:
+            out.write("  last scalar  step %s\n" % fr["last_scalar_step"])
+        shown = (fr.get("events") or [])[-max(events, 0):]
+        if shown:
+            out.write("  last %d event(s)\n" % len(shown))
+        for ev in shown:
+            tags = ev.get("tags") or {}
+            desc = " ".join("%s=%s" % (k, v) for k, v in sorted(tags.items()))
+            if ev.get("type") == "span":
+                out.write("    span    %-20s %8.2f ms  %s\n"
+                          % (ev.get("name"), ev.get("dur", 0.0) / 1e3, desc))
+            else:
+                out.write("    %-7s %-20s %8s     %s\n"
+                          % (ev.get("type"), ev.get("name"),
+                             ev.get("total", ev.get("value")), desc))
 
     tel = bundle.get("telemetry") or {}
     counters = tel.get("counters") or {}

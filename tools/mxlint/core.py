@@ -86,17 +86,17 @@ def all_rules():
     share the collective-site model); single-rule modules expose
     ``RULE``."""
     from . import (rule_jit, rule_sync, rule_env, rule_noop, rule_thread,
-                   rule_ckey, rule_coll, rule_thr2)
+                   rule_ckey, rule_coll, rule_thr2, rule_tel)
     table = {}
     for m in (rule_jit, rule_sync, rule_env, rule_noop, rule_thread,
-              rule_ckey, rule_coll, rule_thr2):
+              rule_ckey, rule_coll, rule_thr2, rule_tel):
         for rid in getattr(m, "RULES", (m.RULE,)):
             table[rid] = m
     return table
 
 
 ALL_RULES = ("JIT001", "SYNC001", "ENV001", "NOOP001", "THR001", "CKEY001",
-             "COLL001", "COLL002", "THR002")
+             "COLL001", "COLL002", "THR002", "TEL001")
 
 
 def lint(root, targets=DEFAULT_TARGETS, rules=None,

@@ -54,7 +54,12 @@ _DOWN_HINTS = ("loss", "entropy", "err", "perplexity", "mae", "mse",
                # ZeRO ladder metrics: per-device param/grad/opt-state
                # residency regresses by going up (docs/distributed.md
                # "ZeRO levels")
-               "param_bytes", "grad_bytes", "opt_bytes")
+               "param_bytes", "grad_bytes", "opt_bytes",
+               # collective wire-bytes accounting: payload moved per step
+               # regresses by going up — a sharding change that silently
+               # widens a collective shows here (docs/observability.md
+               # "wire-bytes accounting")
+               "wire_bytes")
 
 _EVENT_TYPES = ("scalar", "span", "counter", "gauge", "hist", "summary")
 
@@ -195,6 +200,24 @@ def _load_bench(run, doc, path):
         run.groups["zero"] = names
         if isinstance(zero.get("config"), dict):
             run.identity["zero"] = dict(zero["config"])
+    # wire-bytes record (dryrun_multichip's per-kind collective payload
+    # accounting): numeric fields are gated headline metrics — bytes on
+    # the wire per step regress by going UP (direction hints); the nested
+    # config block (device count / batch shape) is IDENTITY — records
+    # stamped on different meshes are different experiments
+    wire = rec.get("wire_bytes") if isinstance(rec, dict) else None
+    if isinstance(wire, dict):
+        names = set()
+        for k, v in wire.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                run.bench[str(k)] = float(v)
+                names.add(str(k))
+        for name in run.bench:
+            if "wire_bytes" in name:
+                names.add(name)
+        run.groups["wire_bytes"] = names
+        if isinstance(wire.get("config"), dict):
+            run.identity["wire_bytes"] = dict(wire["config"])
     chained = (run.meta or {}).get("telemetry_scalars")
     if chained:
         for candidate in (chained,

@@ -20,10 +20,25 @@ span durations, the slowest rank, and the skew ratio (slowest mean over
 the median mean of the other ranks).  A ratio above ``--straggler-ratio``
 (default 1.25) flags the straggler — the rank every collective waits for.
 
+The **step-anatomy table** decomposes each rank's mean step into the
+phases the fit loop's span families already record — ``data_wait``
+(input pipeline), compute (``fused_step`` or the general-path
+``forward``/``backward``/``update``/``forward_backward`` plus
+``metric``, exclusive of the comm/stall nested inside), comm
+(``dist.allreduce``, ``zero.gather``), stall (``pp.bubble``) and the
+unattributed remainder — and its straggler verdict names the rank AND
+the phase that makes it slow ("rank 1 is 3.1x the fleet, dominated by
+data_wait"), turning "who is slow" into "what to fix".
+
+``--timeline OUT.json`` additionally writes the offset-corrected fleet
+timeline (one chrome-trace track per rank, via tools/trace_merge.py —
+load it at https://ui.perfetto.dev).
+
 Usage:
     python tools/telemetry_agg.py /tmp/t.jsonl          # base: globs .rank*
     python tools/telemetry_agg.py /tmp/t.jsonl.rank0 /tmp/t.jsonl.rank1
     python tools/telemetry_agg.py /tmp/t.jsonl --json   # machine-readable
+    python tools/telemetry_agg.py /tmp/t.jsonl --timeline fleet.trace.json
 
 Pure stdlib (usable offline, away from the training image); also imported
 as a library by ``tools/telemetry_report.py --ranks``.  Histogram quantile
@@ -47,6 +62,21 @@ from collections import defaultdict
 
 SKEW_SPANS = ("step", "dist.allreduce")
 STRAGGLER_RATIO = 1.25
+
+# step-anatomy phase families (mxnet_tpu span names).  Compute lists the
+# fit loop's mutually-exclusive alternatives (the fused span OR the
+# general-path trio OR the grad-array variant) — whichever path ran is
+# the only one populated, so summing the family never double-counts.
+# comm and stall spans nest INSIDE the compute spans (the kvstore
+# allreduce runs inside ``update``, the pipeline bubble inside
+# ``fused_step``), so compute is reported exclusive of them.
+ANATOMY_PHASES = (
+    ("data_wait", ("data_wait",)),
+    ("compute", ("fused_step", "forward_backward", "forward", "backward",
+                 "update", "metric")),
+    ("comm", ("dist.allreduce", "zero.gather")),
+    ("stall", ("pp.bubble",)),
+)
 
 # span-fed histograms and span durations are microseconds (telemetry.py)
 _US_PER_MS = 1e3
@@ -375,6 +405,61 @@ def stage_skew_report(per_rank, ratio=STRAGGLER_RATIO):
     }
 
 
+def step_anatomy(per_rank, ratio=STRAGGLER_RATIO):
+    """Per-rank, per-phase decomposition of the mean step (ms) from the
+    fit loop's span families (see ANATOMY_PHASES), plus a verdict that
+    names the straggler rank AND the phase responsible: the phase whose
+    per-step mean exceeds the median of the other ranks' by the largest
+    margin.  Empty dict when no rank recorded ``step`` spans."""
+    table = {}
+    for rank, st in per_rank.items():
+        durs = st["span_durs"]
+        steps = durs.get("step")
+        if not steps:
+            continue
+        n = len(steps)
+        row = {"steps": n, "step_ms": sum(steps) / n / _US_PER_MS}
+        totals = {}
+        for phase, names in ANATOMY_PHASES:
+            totals[phase] = sum(sum(durs.get(nm, ())) for nm in names)
+        # compute exclusive of the comm/stall spans nested inside it
+        totals["compute"] = max(
+            0.0, totals["compute"] - totals["comm"] - totals["stall"])
+        for phase in totals:
+            row[phase + "_ms"] = totals[phase] / n / _US_PER_MS
+        row["other_ms"] = max(
+            0.0, row["step_ms"] - sum(totals.values()) / n / _US_PER_MS)
+        table[rank] = row
+    if not table:
+        return {}
+    phases = [p for p, _ in ANATOMY_PHASES] + ["other"]
+    means = sorted((rec["step_ms"], rank) for rank, rec in table.items())
+    slowest_mean, slowest_rank = means[-1]
+    rest = [m for m, _ in means[:-1]] or [slowest_mean]
+    median_mean = percentile(rest, 0.5)
+    skew = slowest_mean / median_mean if median_mean else float("inf")
+    # blame the phase with the largest per-step excess over the other
+    # ranks' median — the phase a fix would actually buy time in
+    blame, blame_excess = None, 0.0
+    for phase in phases:
+        col = phase + "_ms"
+        others = [table[r][col] for r in table if r != slowest_rank] \
+            or [table[slowest_rank][col]]
+        excess = table[slowest_rank][col] - percentile(others, 0.5)
+        if blame is None or excess > blame_excess:
+            blame, blame_excess = phase, excess
+    return {
+        "ranks": table,
+        "phases": phases,
+        "slowest_rank": slowest_rank,
+        "skew_ratio": skew,
+        "slow_phase": blame,
+        "slow_phase_excess_ms": blame_excess,
+        "straggler": slowest_rank if (len(table) >= 2 and skew >= ratio)
+        else None,
+    }
+
+
 # ----------------------------------------------------------------- top level
 def aggregate(paths, skew_spans=SKEW_SPANS, ratio=STRAGGLER_RATIO):
     """Load + merge a set of per-rank files.  Files without a rank suffix
@@ -393,6 +478,7 @@ def aggregate(paths, skew_spans=SKEW_SPANS, ratio=STRAGGLER_RATIO):
     merged["skew"] = straggler_report(per_rank, names=skew_spans,
                                       ratio=ratio)
     merged["stage_skew"] = stage_skew_report(per_rank, ratio=ratio)
+    merged["anatomy"] = step_anatomy(per_rank, ratio=ratio)
     merged["per_rank"] = per_rank
     return merged
 
@@ -459,6 +545,29 @@ def render(agg, out=None):
                      " [schedule %s]" % sched if sched else "",
                      stage["skew_ratio"], verdict))
 
+    anatomy = agg.get("anatomy")
+    if anatomy:
+        cols = anatomy["phases"]
+        out.write("\nStep anatomy (per-rank mean, ms/step)\n")
+        out.write("%6s %8s %10s" % ("rank", "steps", "step_ms"))
+        for p in cols:
+            out.write(" %10s" % p)
+        out.write("\n")
+        for rank in sorted(anatomy["ranks"]):
+            rec = anatomy["ranks"][rank]
+            out.write("%6s %8d %10.3f" % (rank, rec["steps"],
+                                          rec["step_ms"]))
+            for p in cols:
+                out.write(" %10.3f" % rec[p + "_ms"])
+            out.write("\n")
+        verdict = "STRAGGLER" if anatomy["straggler"] is not None else "ok"
+        out.write("  slowest rank: %s (%.2fx the median of the other "
+                  "ranks), dominated by %s (+%.3f ms/step vs the fleet) "
+                  "— %s\n"
+                  % (anatomy["slowest_rank"], anatomy["skew_ratio"],
+                     anatomy["slow_phase"],
+                     anatomy["slow_phase_excess_ms"], verdict))
+
     counters = agg["counters"]
     if counters:
         out.write("\nCounters (summed across ranks)\n")
@@ -473,6 +582,19 @@ def render(agg, out=None):
             vals = ", ".join("rank%s=%s" % (r, gauges[r][name])
                              for r in sorted(gauges) if name in gauges[r])
             out.write("  %-24s %s\n" % (name, vals))
+
+
+def _sibling(name):
+    """Load a sibling tool as a library (tools/ is not a package) — the
+    telemetry_report idiom; --timeline shares trace_merge's one merge
+    implementation instead of growing a second."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "%s.py" % name)
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _strip_per_rank(agg):
@@ -495,6 +617,10 @@ def main(argv=None):
                          "exceeds this (default %(default)s)")
     ap.add_argument("--json", action="store_true",
                     help="emit the merged view as one JSON document")
+    ap.add_argument("--timeline", metavar="OUT",
+                    help="also write the offset-corrected fleet timeline "
+                         "(chrome-trace JSON, one track per rank) via "
+                         "tools/trace_merge.py")
     args = ap.parse_args(argv)
     paths = list(args.paths)
     if len(paths) == 1 and rank_of(paths[0]) is None:
@@ -510,6 +636,15 @@ def main(argv=None):
         return 1
     spans = tuple(SKEW_SPANS) + tuple(args.span or ())
     agg = aggregate(paths, skew_spans=spans, ratio=args.straggler_ratio)
+    if args.timeline:
+        tm = _sibling("trace_merge")
+        doc, _notes = tm.merge_paths(paths)
+        with open(args.timeline, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        sys.stderr.write("telemetry_agg: wrote fleet timeline (%d trace "
+                         "event(s)) to %s\n"
+                         % (len(doc["traceEvents"]), args.timeline))
     if args.json:
         json.dump(_strip_per_rank(agg), sys.stdout, indent=1, default=str)
         sys.stdout.write("\n")

@@ -35,6 +35,11 @@ summed, latency histograms bucket-merged, per-rank skew columns and the
 straggler verdict — is rendered via the aggregation library
 (tools/telemetry_agg.py) instead of the single-file breakdown.
 
+With ``--json`` the step-time breakdown (or, combined with ``--ranks``,
+the merged fleet view) is emitted as one machine-readable JSON document
+carrying the same fields as the rendered tables — the stable interface
+for dashboards and CI scripts.
+
 Pure stdlib; safe to point at a file from a live run (partial last line is
 ignored).
 """
@@ -180,6 +185,39 @@ def render_counters(counters, out):
     out.write("\nCounters\n")
     for name in sorted(counters):
         out.write("  %-24s %s\n" % (name, counters[name]))
+
+
+def breakdown_json(steps, counters, gauges, has_summary):
+    """The --json view: the step-time breakdown as one document with the
+    SAME fields the rendered table shows (totals/means/shares in ms,
+    coverage, counter and gauge totals) — for dashboards and CI scripts
+    that would otherwise scrape the table."""
+    order = component_order(steps)
+    keys = sorted(steps)
+    measured = [k for k in keys if steps[k]["step"] is not None]
+    total_step = sum(steps[k]["step"] for k in measured)
+    nsteps = sum(steps[k]["n"] for k in measured) or len(measured)
+    components = {}
+    comp_sum = 0.0
+    for c in order:
+        tot = sum(steps[k]["components"].get(c, 0.0) for k in measured)
+        comp_sum += tot
+        components[c] = {
+            "total_ms": tot / 1e3,
+            "mean_ms": tot / nsteps / 1e3 if nsteps else 0.0,
+            "share": tot / total_step if total_step else 0.0,
+        }
+    return {
+        "steps": nsteps,
+        "partial_steps": len(keys) - len(measured),
+        "total_step_ms": total_step / 1e3,
+        "mean_step_ms": total_step / nsteps / 1e3 if nsteps else 0.0,
+        "components": components,
+        "coverage": comp_sum / total_step if total_step else 0.0,
+        "counters": counters,
+        "gauges": gauges,
+        "has_summary": has_summary,
+    }
 
 
 # --------------------------------------------------------------- curves view
@@ -339,12 +377,19 @@ def main(argv=None):
                          "counters, bucket-merged histograms, per-rank "
                          "skew + straggler report); the bare <path> is "
                          "used only when no rank files exist")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the step-time breakdown (or, with --ranks, "
+                         "the merged fleet view) as one JSON document "
+                         "instead of the rendered tables")
     args = ap.parse_args(argv)
     if args.ranks and (args.health or args.steps or args.curves or
                        args.epoch is not None):
         ap.error("--ranks renders the fleet view only; --health/--steps/"
                  "--curves/--epoch apply to a single-rank report (run "
                  "them against one <path>.rankN file)")
+    if args.json and (args.health or args.steps or args.curves):
+        ap.error("--json emits the breakdown document; --health/--steps/"
+                 "--curves shape the rendered tables only")
     if args.ranks:
         agg = _agg_lib()
         files = agg.rank_files(args.path)
@@ -352,7 +397,13 @@ def main(argv=None):
             sys.stderr.write("telemetry_report: no files match %s[.rank*]\n"
                              % args.path)
             return 1
-        agg.render(agg.aggregate(files))
+        merged = agg.aggregate(files)
+        if args.json:
+            json.dump(agg._strip_per_rank(merged), sys.stdout, indent=1,
+                      default=str)
+            sys.stdout.write("\n")
+        else:
+            agg.render(merged)
         return 0
     try:
         events = load_events(args.path)
@@ -361,6 +412,12 @@ def main(argv=None):
                          % (args.path, getattr(e, "strerror", None) or e))
         return 1
     counters, gauges, has_summary = summary_state(events)
+    if args.json:
+        doc = breakdown_json(collect_steps(events, epoch=args.epoch),
+                             counters, gauges, has_summary)
+        json.dump(doc, sys.stdout, indent=1, default=str)
+        sys.stdout.write("\n")
+        return 0
     if events and not has_summary:
         sys.stdout.write("note: no summary event — run still live or died "
                          "before telemetry.stop(); totals folded from the "

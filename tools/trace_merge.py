@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Merge per-rank telemetry streams into ONE fleet timeline (chrome trace).
+
+A multi-process run leaves one event stream per rank — a telemetry
+JSON-lines file (``MXNET_TELEMETRY``, ``<path>.rank<N>``) and/or a
+flight-recorder diagnostics bundle (``MXNET_FLIGHT_RECORDER``; the
+``mxtpu_diag.*.json`` written on a crash/stall/kill).  Each stream
+timestamps with its OWN wall clock, so laying them side by side skews
+every cross-rank comparison by the hosts' clock offsets.  This tool
+merges any mix of the two formats into a single Perfetto-loadable
+chrome-trace JSON:
+
+* one track (trace ``pid``) per rank, named ``rank N``,
+* span events offset-corrected onto rank 0's clock using the
+  ``clock_offset_sec`` gauge each stream carries (``parallel.dist``
+  estimates it at barrier entries over the coordination service — see
+  docs/observability.md "fleet timeline"); a stream without the gauge
+  merges uncorrected with a note,
+* tags preserved as ``args`` (pipeline ``stage``/``schedule`` tags keep
+  their meaning in the merged view),
+* counters/gauges/scalars rendered as chrome-trace counter tracks.
+
+Usage:
+    python tools/trace_merge.py /tmp/t.jsonl -o fleet.trace.json
+    python tools/trace_merge.py /tmp/t.jsonl.rank0 mxtpu_diag.fatal_signal.pid7.rank1.json -o fleet.trace.json
+
+Load the output at https://ui.perfetto.dev or chrome://tracing.  Pure
+stdlib (usable offline, away from the training image).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_OFFSET_GAUGE = "clock_offset_sec"
+
+
+# ------------------------------------------------------------------- loading
+def rank_of(path):
+    """Rank from the launch-contract filename (``.rank<N>`` suffix,
+    possibly before an extension: ``...rank1.json``), else None."""
+    m = re.search(r"\.rank(\d+)(?:\.[A-Za-z]+)?$", path)
+    return int(m.group(1)) if m else None
+
+
+def load_stream(path):
+    """One per-rank stream → ``{rank, events, offset_sec, source, path}``.
+
+    Accepts a telemetry JSON-lines file or a diagnostics bundle (the
+    flight-recorder ring plus the recent-event tail).  ``offset_sec`` is
+    the stream's own ``clock_offset_sec`` estimate (last one recorded),
+    or None when the stream never exchanged clocks."""
+    with open(path) as f:
+        text = f.read()
+    # a diagnostics bundle parses as ONE document; a telemetry JSONL file
+    # (every line its own object) fails whole-file parsing with Extra data
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict):
+        if doc.get("type") == "mxtpu_diagnostics":
+            return _from_bundle(doc, path)
+        # a single-line telemetry file is still a one-event stream
+        doc = None if "ts" in doc else doc
+        if doc is not None:
+            raise ValueError("%s: a JSON document but not an mxnet_tpu "
+                             "diagnostics bundle (type=%r)"
+                             % (path, doc.get("type")))
+    events = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            events.append(json.loads(line))
+        except ValueError:
+            continue   # partial trailing line of a live run
+    rank = rank_of(path)
+    return {"rank": rank, "events": events, "path": path,
+            "offset_sec": _stream_offset(events), "source": "jsonl"}
+
+
+def _from_bundle(doc, path):
+    fr = doc.get("flight_recorder") or {}
+    tel = doc.get("telemetry") or {}
+    # the ring is the richer record; a bundle written without the recorder
+    # armed still carries the telemetry recent-event tail
+    events = list(fr.get("events") or tel.get("recent_events") or [])
+    rank = doc.get("rank")
+    try:
+        rank = int(rank)
+    except (TypeError, ValueError):
+        rank = rank_of(path)
+    offset = _stream_offset(events)
+    if offset is None:
+        g = (tel.get("gauges") or {}).get(_OFFSET_GAUGE)
+        offset = float(g) if isinstance(g, (int, float)) else None
+    return {"rank": rank, "events": events, "path": path,
+            "offset_sec": offset, "source": "bundle"}
+
+
+def _stream_offset(events):
+    """Last clock_offset_sec gauge in an event stream, else None."""
+    for ev in reversed(events):
+        if ev.get("type") == "gauge" and ev.get("name") == _OFFSET_GAUGE:
+            try:
+                return float(ev.get("value"))
+            except (TypeError, ValueError):
+                return None
+        if ev.get("type") == "summary":
+            g = (ev.get("gauges") or {}).get(_OFFSET_GAUGE)
+            if isinstance(g, (int, float)):
+                return float(g)
+    return None
+
+
+# ------------------------------------------------------------------- merging
+def merge(streams):
+    """List of ``load_stream`` dicts → chrome-trace document.
+
+    Every rank's timestamps shift by its ``offset_sec`` (estimated
+    against rank 0), so a span that STARTED simultaneously on two hosts
+    renders simultaneously regardless of their wall-clock skew.  Returns
+    ``(trace_doc, notes)`` where notes list per-rank correction info."""
+    # deduplicate rank labels the way telemetry_agg.aggregate does:
+    # unknown or repeated ranks get the lowest free pseudo-rank
+    by_rank = {}
+    for st in streams:
+        rank = st["rank"]
+        if rank is None or rank in by_rank:
+            rank = 0
+            while rank in by_rank:
+                rank += 1
+        by_rank[rank] = st
+    trace_events = []
+    notes = []
+    for rank in sorted(by_rank):
+        st = by_rank[rank]
+        offset = st["offset_sec"]
+        corrected = offset is not None
+        shift_us = (offset or 0.0) * 1e6
+        notes.append({"rank": rank, "path": st["path"],
+                      "source": st["source"],
+                      "offset_sec": offset if corrected else None,
+                      "corrected": corrected,
+                      "events": len(st["events"])})
+        trace_events.append({"ph": "M", "name": "process_name",
+                             "pid": rank, "tid": 0,
+                             "args": {"name": "rank %d%s"
+                                      % (rank, "" if corrected
+                                         else " (uncorrected clock)")}})
+        trace_events.append({"ph": "M", "name": "process_sort_index",
+                             "pid": rank, "tid": 0,
+                             "args": {"sort_index": rank}})
+        for ev in st["events"]:
+            t = ev.get("type")
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)):
+                continue
+            ts -= shift_us
+            if t == "span":
+                out = {"ph": "X", "name": ev.get("name"),
+                       "cat": ev.get("cat", "runtime"),
+                       "ts": ts, "dur": ev.get("dur", 0.0),
+                       "pid": rank, "tid": 0}
+                if ev.get("tags"):
+                    out["args"] = ev["tags"]
+                trace_events.append(out)
+            elif t in ("counter", "gauge", "scalar", "hist"):
+                val = ev.get("total", ev.get("value"))
+                if not isinstance(val, (int, float)):
+                    continue
+                trace_events.append({"ph": "C", "name": ev.get("name"),
+                                     "ts": ts, "pid": rank, "tid": 0,
+                                     "args": {"value": val}})
+            # summary events carry no timeline position of their own
+    trace_events.sort(key=lambda e: (e.get("ts", 0.0), e["pid"]))
+    return ({"traceEvents": trace_events, "displayTimeUnit": "ms"}, notes)
+
+
+def merge_paths(paths):
+    """Convenience: load + merge; the library entry the tests drive."""
+    return merge([load_stream(p) for p in paths])
+
+
+# ----------------------------------------------------------------- top level
+def _expand(paths):
+    """ONE extension-less base path expands to ``<base>.rank*`` (the
+    launch contract), matching telemetry_agg's file discovery."""
+    if len(paths) != 1 or rank_of(paths[0]) is not None:
+        return paths
+    import glob as _glob
+    files = sorted((p for p in _glob.glob(_glob.escape(paths[0]) + ".rank*")
+                    if rank_of(p) is not None), key=rank_of)
+    if files:
+        return files
+    return paths
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="per-rank telemetry JSONL files and/or "
+                         "flight-recorder diagnostics bundles; ONE base "
+                         "path expands to <base>.rank*")
+    ap.add_argument("-o", "--output", default=None,
+                    help="merged chrome-trace JSON path (default: stdout)")
+    args = ap.parse_args(argv)
+    paths = _expand(list(args.paths))
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        sys.stderr.write("trace_merge: cannot read %s\n"
+                         % ", ".join(missing))
+        return 1
+    try:
+        streams = [load_stream(p) for p in paths]
+    except (OSError, ValueError) as e:
+        sys.stderr.write("trace_merge: %s\n" % e)
+        return 1
+    doc, notes = merge(streams)
+    for n in notes:
+        sys.stderr.write(
+            "trace_merge: rank %s (%s, %d event(s)) %s\n"
+            % (n["rank"], n["source"], n["events"],
+               "offset %+0.6fs" % n["offset_sec"] if n["corrected"]
+               else "no clock_offset_sec — merged uncorrected"))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        sys.stderr.write("trace_merge: wrote %d trace event(s) to %s\n"
+                         % (len(doc["traceEvents"]), args.output))
+    else:
+        json.dump(doc, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)
