@@ -11,6 +11,7 @@ from . import telemetry
 from . import sanitize
 from . import metrics_server
 from . import diagnostics
+from . import sentinel
 from . import ndarray
 from . import ndarray as nd
 from . import random

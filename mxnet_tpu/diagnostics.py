@@ -313,6 +313,32 @@ def snapshot(reason, exc=None, extra=None):
             bundle["resize"] = rz
     except Exception:   # diagnostics must never add a second failure
         pass
+    try:
+        from . import sentinel as _sen
+        if _sen._on:
+            # live-sentinel state: the last step's phase anatomy, the
+            # rolling baselines it was judged against, the latest fired
+            # anomaly and the cross-rank straggler verdict — a
+            # perf_anomaly or oom bundle is then self-contained
+            from .parallel import dist as _dist
+            bundle["sentinel"] = {
+                "anatomy": _sen.anatomy(),
+                "last_step": _sen.last_anatomy(),
+                "last_anomaly": _sen.last_anomaly(),
+                "straggler": _dist.straggler(),
+            }
+    except Exception:   # diagnostics must never add a second failure
+        pass
+    try:
+        from . import sanitize as _san
+        hbm = _san.hbm_ledger()
+        if hbm:
+            # per-program HBM attribution (sentinel / hbm_report): which
+            # compiled program holds how many bytes — the first question
+            # an oom bundle must answer
+            bundle["hbm"] = hbm
+    except Exception:   # diagnostics must never add a second failure
+        pass
     if exc is not None:
         bundle["exception"] = {
             "type": type(exc).__name__,
@@ -338,6 +364,11 @@ def write_snapshot(reason, exc=None, extra=None):
         n += 1
     bundle = snapshot(reason, exc=exc, extra=extra)
     try:
+        # MXNET_DIAG_DIR is usually pointed at a fresh path mid-incident;
+        # a missing directory must not cost the evidence
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         with open(path, "w") as f:
             json.dump(bundle, f, indent=1, default=str)
             f.write("\n")

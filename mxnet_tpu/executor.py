@@ -1023,6 +1023,12 @@ class Executor(object):
                         jax.checkpoint_policies.dots_with_no_batch_dims_saveable
                 f = jax.checkpoint(f, policy=policy)
             fn = jax.jit(f)
+        if _san._hbm_on:
+            # per-program HBM attribution (sentinel): the first call's
+            # concrete arguments drive one lower+compile whose executable
+            # the dispatch reuses; grad kinds first fire under jax.vjp
+            # with tracers, where hbm_capture degrades to a silent skip
+            fn = self._hbm_first_call(fn, kind)
         if _tel._enabled:
             # jax.jit is lazy: the miss's trace+compile cost lands on the
             # FIRST invocation, not here — time that call as an
@@ -1058,6 +1064,20 @@ class Executor(object):
             self._jit_cache[cache_key] = fn
             return out
         return first_call
+
+    def _hbm_first_call(self, fn, kind):
+        """Wrap a fresh jit so its first invocation records the compiled
+        program's memory analysis into mxsan's HBM ledger (best-effort:
+        tracer arguments or lowering errors degrade to a skip), then
+        step out of the way."""
+        state = {"done": False}
+
+        def hbm_first_call(*args):
+            if not state["done"]:
+                state["done"] = True
+                _san.hbm_capture("executor.%s" % kind, fn, args)
+            return fn(*args)
+        return hbm_first_call
 
     def _check_default_heads(self):
         """Warn when implicit all-ones head gradients reach non-loss outputs

@@ -103,7 +103,10 @@ def _escape_label(v):
 
 
 def prometheus_text():
-    """Text exposition (version 0.0.4) of the live telemetry registry."""
+    """Text exposition (version 0.0.4) of the live telemetry registry.
+    Built from one atomic registry snapshot — counter/gauge/histogram
+    families in a single scrape describe the same instant."""
+    reg = _tel.registry_snapshot()
     lines = []
     # constant info gauge (value 1, identity in the labels) — the
     # Prometheus convention for build metadata, cf. python_info
@@ -111,7 +114,7 @@ def prometheus_text():
     extra = ['%s="%s"' % (k, _escape_label(v))
              for k, v in sorted(build_info().items())]
     lines.append("mxnet_build_info%s 1" % _labels(extra))
-    for name, v in sorted(_tel.counters().items()):
+    for name, v in sorted(reg["counters"].items()):
         # the conventional _total suffix also keeps counter families from
         # colliding with a span histogram of the sanitized same name
         # (counter "dist_allreduce" vs span "dist.allreduce") — duplicate
@@ -119,14 +122,14 @@ def prometheus_text():
         m = "mxtpu_" + _sanitize(name) + "_total"
         lines.append("# TYPE %s counter" % m)
         lines.append("%s%s %s" % (m, _labels(), _fmt(v)))
-    for name, v in sorted(_tel.gauges().items()):
+    for name, v in sorted(reg["gauges"].items()):
         m = "mxtpu_" + _sanitize(name)
         lines.append("# TYPE %s gauge" % m)
         try:
             lines.append("%s%s %s" % (m, _labels(), _fmt(float(v))))
         except (TypeError, ValueError):
             continue   # non-numeric gauge has no Prometheus representation
-    for name, h in sorted(_tel.histograms().items()):
+    for name, h in sorted(reg["histograms"].items()):
         m = "mxtpu_" + _sanitize(name)
         lines.append("# TYPE %s histogram" % m)
         cum = 0
@@ -148,9 +151,15 @@ def prometheus_text():
 
 def json_snapshot():
     """One JSON document of the live registry, histogram quantiles
-    included — the machine-readable twin of ``/metrics``."""
+    included — the machine-readable twin of ``/metrics``.  All four
+    registries come from a single ``registry_snapshot()`` lock
+    acquisition, so a scrape racing the training loop never returns a
+    torn document (counters from one step, gauges from the next) —
+    regression-pinned by the threaded atomicity test in
+    test_fleet_observability.py."""
+    reg = _tel.registry_snapshot()
     hists = {}
-    for name, h in _tel.histograms().items():
+    for name, h in reg["histograms"].items():
         h = dict(h)
         h["quantiles"] = {
             "p50": _tel.quantile_from_hist(h, 0.50),
@@ -163,8 +172,8 @@ def json_snapshot():
         "rank": get_env("MXTPU_PROCESS_ID"),
         "recording": _tel.enabled(),
         "build_info": build_info(),
-        "counters": _tel.counters(),
-        "gauges": _tel.gauges(),
+        "counters": reg["counters"],
+        "gauges": reg["gauges"],
         "histograms": hists,
         # last point of every training-curve series (train_loss, lr,
         # grad_norm[param=...], ...) — "where is the loss right now"
@@ -176,7 +185,7 @@ def json_snapshot():
         "scalars": {k: dict(s, value=s["value"]
                             if math.isfinite(s["value"])
                             else str(s["value"]))
-                    for k, s in _tel.scalars().items()},
+                    for k, s in reg["scalars"].items()},
     }
 
 

@@ -855,8 +855,27 @@ class _FusedFit(object):
         batch = getattr(data_batch, "_staged", None)
         if batch is None:
             batch = self._host_batch(data_batch)
-        self._params, self._state, self._aux, outs = self._ts(
-            self._params, self._state, self._aux, batch)
+        try:
+            self._params, self._state, self._aux, outs = self._ts(
+                self._params, self._state, self._aux, batch)
+        except Exception as e:
+            # device OOM post-mortem: XLA surfaces it as RESOURCE_EXHAUSTED
+            # somewhere in the raised error's text.  Dump a self-contained
+            # bundle — the per-program HBM ledger, the flight-recorder
+            # ring, and the sentinel's last step anatomy all ride the
+            # standard diagnostics sections — then re-raise untouched.
+            # Gated like every other snapshot writer: only when crash
+            # snapshots or the sentinel are armed does an exception write
+            # a file.
+            if "RESOURCE_EXHAUSTED" in str(e):
+                try:
+                    from .. import diagnostics as _dg
+                    from .. import sentinel as _sen
+                    if _dg.crash_snapshots_active() or _sen._on:
+                        _dg.write_snapshot("oom", exc=e)
+                except Exception:
+                    pass
+            raise
         # current weights now live in the fused pytrees, not the executors —
         # route mid-epoch get_params through us (see _sync_params_from_devices)
         self._mod._params_dirty = True

@@ -240,8 +240,10 @@ def shutdown_process_group(graceful=False):
     _initialized = False
     _worker_mesh = None
     _sum_cache.clear()
-    # clock offsets are world-relative: the next world re-estimates
+    # clock offsets and straggler verdicts are world-relative: the next
+    # world re-estimates / re-exchanges from scratch
     _clock_reset()
+    _sentinel_reset()
 
 
 def rank():
@@ -283,6 +285,7 @@ def barrier(name=None):
     from jax.experimental import multihost_utils
     from .. import sanitize as _san
     _clock_exchange()
+    _sentinel_exchange()
     with _san.collective_dispatch("barrier", name=name):
         # exchange BEFORE waiting: two ranks arriving with different
         # barrier names (or divergent dispatch histories) are named here
@@ -403,6 +406,7 @@ def coordination_barrier(name, timeout_ms=600000):
         return
     from .. import sanitize as _san
     _clock_exchange()
+    _sentinel_exchange()
     # device=False: the service barrier is thread-safe by design — the
     # checkpoint writer thread meeting its peers here is the sanctioned
     # pattern, not an off-main-thread violation
@@ -536,6 +540,98 @@ def wire_bytes():
     ``dryrun_multichip`` wire ladder built on this accounting."""
     from .. import sanitize as _san
     return _san.wire_bytes()
+
+
+# --------------------------------------------------------------------------
+# Cross-rank sentinel digest exchange (live straggler naming)
+# --------------------------------------------------------------------------
+# The clock exchange's perf twin: at every barrier entry each rank
+# publishes its sentinel step-summary digest (per-phase EWMA means — a
+# few hundred bytes of JSON) under a seq-numbered key on the
+# coordination service and reads every peer's, so ALL ranks can answer
+# "who is slow, and in which phase" mid-run — not just rank 0.
+# Key-value RPC only: the collective ledger and hash chain stay quiet,
+# exactly like the clock exchange above.  Gated on the sentinel being
+# armed AND detecting (MXNET_SENTINEL=step:<k>sigma...); unset, nothing
+# is published and no state accrues (import-noop pinned).  Main-thread
+# only for the same seq-agreement reason as the clock.
+_sent_lock = threading.Lock()
+_sent_seq = 0
+_straggler = None         # latest (rank, phase, slowdown) verdict
+_SENT_TIMEOUT_MS = 5000
+
+
+def straggler():
+    """Latest cross-rank straggler verdict ``(rank, phase, slowdown)``
+    — the slowest rank's id, its dominant divergent phase (data_wait /
+    compute / stall) and its mean-step-time ratio over the median of the
+    other ranks — or None before the first digest exchange (or with the
+    sentinel disarmed).  Every rank holds the same verdict, refreshed at
+    each barrier/epoch exchange point."""
+    return _straggler
+
+
+def _sentinel_reset():
+    global _sent_seq, _straggler
+    with _sent_lock:
+        _sent_seq = 0
+        _straggler = None
+
+
+def _sentinel_exchange():
+    """One digest exchange at a barrier entry (see above).  Must never
+    fail or stall the barrier: every service error degrades to a lost
+    round."""
+    global _sent_seq, _straggler
+    from .. import sentinel as _sen
+    if not _sen._on or not _sen._detect:
+        return
+    if threading.current_thread() is not threading.main_thread():
+        return
+    client = coordination_client()
+    if client is None:
+        return
+    try:
+        world, myrank = peer_world()
+    except Exception:
+        return
+    if world <= 1:
+        return
+    mine = _sen.digest()
+    if mine is None:
+        return   # no baseline yet (pre-first-step barrier)
+    import json as _json
+    with _sent_lock:
+        _sent_seq += 1
+        n = _sent_seq
+    try:
+        client.key_value_set("mxtpu-sent/%d/%d" % (n, myrank),
+                             _json.dumps(mine))
+        if n > 2:
+            try:
+                client.key_value_delete("mxtpu-sent/%d/%d"
+                                        % (n - 2, myrank))
+            except Exception:
+                pass
+        digests = {myrank: mine}
+        for r in range(world):
+            if r == myrank:
+                continue
+            raw = client.blocking_key_value_get(
+                "mxtpu-sent/%d/%d" % (n, r), _SENT_TIMEOUT_MS)
+            digests[r] = _json.loads(str(raw))
+    except Exception:
+        return   # a lost round must never fail the barrier
+    verdict = _sen.name_straggler(digests)
+    if verdict is None:
+        return
+    with _sent_lock:
+        _straggler = verdict
+    from .. import telemetry as _tel
+    if _tel._enabled:
+        srank, phase, slowdown = verdict
+        _tel.gauge("straggler_rank", srank, phase=phase)
+        _tel.gauge("straggler_slowdown", round(slowdown, 4))
 
 
 # --------------------------------------------------------------------------

@@ -107,7 +107,8 @@ __all__ = ["SanitizerError", "SanitizerWarning", "arm", "disarm", "armed",
            "collective_dispatch", "collective_sync", "collective_sig",
            "allow_thread_collective", "ledger_tail", "collective_state",
            "expect_recompile", "sig_nbytes", "record_wire_bytes",
-           "wire_bytes"]
+           "wire_bytes", "hbm_arm", "hbm_disarm", "hbm_ledger",
+           "hbm_note", "hbm_capture", "hbm_wrap"]
 
 CHECKERS = ("recompile", "sync", "donate", "collective")
 
@@ -164,6 +165,8 @@ _stats = {"recompile_violations": 0, "sync_violations": 0,
 _violations = deque(maxlen=200)
 _wire_bytes = {}          # (kind, axes) -> cumulative payload bytes folded
                           # out of dispatch signatures (record_wire_bytes)
+_hbm_on = False           # per-program HBM attribution armed (sentinel)
+_hbm_ledger = {}          # program name -> memory_analysis byte breakdown
 _tls = threading.local()
 _log_handler = None       # compile-log watcher state
 _log_prev_level = None
@@ -750,6 +753,103 @@ def wire_bytes():
         return {"%s/%s" % k: v for k, v in sorted(_wire_bytes.items())}
 
 
+# ------------------------------------------- per-program HBM attribution
+# The wire-bytes ledger's memory twin: every jit cache registered
+# through register_cache captures its compiled program's
+# ``memory_analysis()`` breakdown (argument / output / temp /
+# generated-code bytes) at compile time.  Metadata only, dist-free, no
+# device work — ``.lower(...).compile()`` on an already-jitted callable
+# reuses the cached executable, and capture happens BEFORE the first
+# call so donated arguments are still alive.  Armed by the sentinel
+# (``MXNET_SENTINEL``); with ``_hbm_on`` False every entry point is one
+# bool read.  Rendered by tools/hbm_report.py; surfaced as the ``hbm``
+# diagnostics-bundle section and the ``hbm_program_bytes`` gauges.
+
+def hbm_arm():
+    """Arm per-program HBM attribution (capture-at-compile)."""
+    global _hbm_on
+    with _lock:
+        _hbm_on = True
+
+
+def hbm_disarm():
+    """Disarm HBM attribution and clear the ledger."""
+    global _hbm_on
+    with _lock:
+        _hbm_on = False
+        _hbm_ledger.clear()
+
+
+def hbm_ledger():
+    """Snapshot of the per-program HBM ledger: ``{name: {args, outputs,
+    temps, generated_code, alias, total}}``, bytes.  ``total`` is
+    args + outputs + temps + generated_code − alias (donated pairs
+    counted once), matching jax's CompiledMemoryStats accounting."""
+    with _lock:
+        return {k: dict(v) for k, v in sorted(_hbm_ledger.items())}
+
+
+def hbm_note(name, mem_stats):
+    """Fold one compiled program's ``CompiledMemoryStats`` into the
+    ledger under ``name`` (last capture wins — a re-trace replaces its
+    predecessor, mirroring the jit cache it describes)."""
+    row = {
+        "args": int(getattr(mem_stats, "argument_size_in_bytes", 0)),
+        "outputs": int(getattr(mem_stats, "output_size_in_bytes", 0)),
+        "temps": int(getattr(mem_stats, "temp_size_in_bytes", 0)),
+        "generated_code": int(
+            getattr(mem_stats, "generated_code_size_in_bytes", 0)),
+        "alias": int(getattr(mem_stats, "alias_size_in_bytes", 0)),
+    }
+    row["total"] = (row["args"] + row["outputs"] + row["temps"]
+                    + row["generated_code"] - row["alias"])
+    with _lock:
+        _hbm_ledger[str(name)] = row
+    if _tel._enabled:
+        _tel.gauge("hbm_program_bytes", row["total"], program=str(name))
+    return row
+
+
+def hbm_capture(name, fn, args=(), kwargs=None):
+    """Lower+compile ``fn`` for ``args`` and record its memory analysis
+    under ``name``.  Best-effort by contract: abstract tracers (an
+    executor grad jit invoked under ``jax.vjp``), backends without
+    ``memory_analysis``, or any lowering error degrade to a silent None
+    — attribution must never add a failure mode to the program it
+    measures."""
+    if not _hbm_on:
+        return None
+    try:
+        compiled = fn.lower(*args, **(kwargs or {})).compile()
+        stats = compiled.memory_analysis()
+        if stats is None:
+            return None
+        return hbm_note(name, stats)
+    except Exception:
+        return None
+
+
+def hbm_wrap(name, fn):
+    """Wrap a jitted callable so its first invocation captures HBM
+    attribution from the very arguments it compiles for.  Returns ``fn``
+    unchanged while attribution is off (the strict-no-op contract); the
+    armed wrapper self-removes its overhead down to one bool read after
+    the first call."""
+    if not _hbm_on:
+        return fn
+    state = {"done": False}
+
+    def first_call(*args, **kwargs):
+        if not state["done"]:
+            state["done"] = True
+            hbm_capture(name, fn, args, kwargs)
+        return fn(*args, **kwargs)
+
+    first_call.__name__ = getattr(fn, "__name__", "first_call")
+    first_call.__wrapped__ = fn
+    return first_call
+
+
 def note_collective(kind, name=None, sig=None, axes=None, device=True):
     """Record one collective dispatch in the per-rank ledger and fold it
     into the rolling hash chain.  ``device=True`` marks a DEVICE
@@ -982,6 +1082,14 @@ def expect_recompile(marker):
             h._warned = 0
     logging.getLogger(__name__).info(
         "mxsan: recompile budgets re-armed at %s", marker)
+    # the live sentinel keys its warmup suppression off the same markers
+    # (a declared re-trace wave must not read as a perf anomaly); lazy
+    # and best-effort — sanitize must never depend on the sentinel
+    try:
+        from . import sentinel as _sentinel
+        _sentinel.note_recompile(marker)
+    except Exception:
+        pass
 
 
 def collective_rebase(marker):
@@ -1411,6 +1519,7 @@ def reset():
             _stats[k] = 0
         _violations.clear()
         _wire_bytes.clear()
+        _hbm_ledger.clear()
         _DONATED.clear()
         _RAW_COMPILES.clear()
         _coll_ledger.clear()

@@ -1,0 +1,526 @@
+"""Live performance sentinel — online step anatomy, rolling-baseline
+anomaly triggers, and the cross-rank straggler digest.
+
+PR 17's flight recorder / trace merge / wire ledger made a run
+explainable *after the fact*; this module is the online twin: the
+running process detects its own step-time regressions, names the
+dominant divergent phase, and (through ``parallel.dist``) names the
+straggler rank — while training is still in progress.
+
+Arm with ``MXNET_SENTINEL=step:<k>sigma[:raise]`` (e.g. ``step:3sigma``;
+``:raise`` fails the run instead of warning).  A ``hbm`` token (alone or
+``step:3sigma,hbm``) arms per-program HBM attribution
+(``sanitize.hbm_ledger``); any armed spec arms it implicitly.  With the
+variable unset this module is a strict no-op: no thread, no file, no
+state accrual — every entry point degrades to one module-global bool
+check (the telemetry/sanitize autostart discipline, pinned in
+test_import_noop.py).
+
+Three pieces:
+
+* **online step anatomy** — ``Module.fit`` feeds :func:`step_close` per
+  batch with the whole-step wall time plus the ``data_wait`` and compute
+  phase durations it already clocks for telemetry; the sentinel derives
+  ``comm_mb`` (the per-step delta of mxsan's wire-bytes ledger — PR 17's
+  accounting, metadata only) and ``stall`` (the residual: callbacks,
+  gates, sync-back) and folds each series into a rolling EWMA +
+  EWM-variance baseline.  The warmup window seeds that baseline from
+  its median + MAD, so the first step's compile time never poisons the
+  mean.  No host syncs beyond what telemetry already takes — the feed
+  is two extra ``perf_counter`` reads per step.
+
+* **rolling-baseline anomaly detection** — after ``MXNET_SENTINEL_WARMUP``
+  baseline steps, a step whose total exceeds ``mean + k*sigma`` for
+  ``MXNET_SENTINEL_CONSEC`` consecutive steps fires: a ``perf_anomaly``
+  telemetry event naming the dominant divergent phase (largest per-phase
+  z-score), a diagnostics bundle (self-contained — arming the sentinel
+  arms the flight-recorder ring when nothing else did), and a warning or
+  :class:`SentinelError` per the mode.  ``sanitize.expect_recompile``
+  markers re-open the warmup window, so legitimate re-trace waves (a
+  live resize, serving bucket growth) never trip it.
+
+* **cross-rank digests** — :func:`digest` is the compact per-rank
+  summary ``parallel.dist`` exchanges over the coordination KV at
+  barrier entries (exactly like PR 17's clock exchange: key-value RPC
+  only, the collective ledger and hash chain stay quiet);
+  :func:`name_straggler` turns a ``{rank: digest}`` map into
+  ``(rank, phase, slowdown)`` — the answer behind ``dist.straggler()``
+  and the ``straggler_rank``/``straggler_slowdown`` gauges.
+
+See docs/observability.md "Live sentinel".
+"""
+from __future__ import annotations
+
+import math
+import threading
+import warnings
+
+from .base import MXNetError, get_env
+from . import telemetry as _tel
+
+__all__ = ["SentinelError", "SentinelWarning", "arm", "disarm", "armed",
+           "step_close", "anatomy", "last_anatomy", "last_anomaly",
+           "digest", "name_straggler", "note_recompile", "reset",
+           "PHASES"]
+
+# the anatomy series: durations in seconds except comm_mb (wire-bytes
+# delta in MB — deviations are still detected per-series in sigma units,
+# so the mixed unit never meets the duration phases in arithmetic)
+PHASES = ("data_wait", "compute", "comm_mb", "stall")
+# duration-typed phases comparable across ranks (name_straggler excludes
+# comm_mb: wire bytes are symmetric across SPMD ranks by construction)
+_DURATION_PHASES = ("data_wait", "compute", "stall")
+_SERIES = ("step",) + PHASES
+# ring capacity when arming the sentinel arms the flight recorder (the
+# anomaly bundle's self-contained timeline)
+_FR_CAP = 512
+# sigma floor: 5% of the mean (or 100 µs) — a perfectly regular synthetic
+# feed drives the EWM variance to ~0 and would turn measurement jitter
+# into infinite z-scores
+_SIGMA_REL_FLOOR = 0.05
+_SIGMA_ABS_FLOOR = 1e-4
+
+
+class SentinelError(MXNetError):
+    """A performance anomaly in ``:raise`` mode."""
+
+
+class SentinelWarning(UserWarning):
+    """A performance anomaly in warn mode (the default)."""
+
+
+_lock = threading.Lock()
+_on = False               # hot-path guard: one bool read while disarmed
+_detect = False           # False under MXNET_SENTINEL=hbm (attribution only)
+_mode = "warn"
+_k_sigma = 3.0
+_consec_k = 5             # MXNET_SENTINEL_CONSEC
+_warmup = 16              # MXNET_SENTINEL_WARMUP
+_alpha = 0.05             # MXNET_SENTINEL_ALPHA (EWMA smoothing)
+_armed_fr = False         # this module armed the flight recorder
+_steps = 0                # samples folded since arm/reset
+_ewma = {}                # series -> [ewma_mean, ewm_variance]
+_last = None              # last step's raw anatomy row
+_consec = 0               # consecutive over-threshold steps
+_suppress = 0             # steps left in a (re-)warmup quiet window
+_last_marker = None       # last expect_recompile marker seen
+_anomalies = 0
+_last_anomaly = None
+_last_wire = None         # wire-bytes ledger total at the previous close
+_warm_buf = {}            # series -> warmup samples (median/MAD seed)
+
+
+def armed():
+    """True while the sentinel is armed (``MXNET_SENTINEL`` / :func:`arm`)."""
+    return _on
+
+
+def _knob(raw, default, typ, lo):
+    """Parse one MXNET_SENTINEL_* knob (the raw ``get_env`` string):
+    unset or malformed falls back to the default, values clamp at
+    ``lo``."""
+    if raw is None:
+        return default
+    try:
+        v = typ(raw)
+    except (TypeError, ValueError):
+        return default
+    return max(lo, v)
+
+
+def _parse_spec(raw):
+    """``step:<k>sigma[,hbm][:raise]`` -> (k_sigma | None, hbm, mode).
+    ``k_sigma`` is None when no ``step`` token armed the detector."""
+    raw = raw.strip()
+    mode = "warn"
+    if raw.endswith(":raise"):
+        mode, raw = "raise", raw[:-len(":raise")]
+    elif raw.endswith(":warn"):
+        raw = raw[:-len(":warn")]
+    k_sigma, hbm = None, False
+    for tok in raw.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok == "hbm":
+            hbm = True
+        elif tok == "step":
+            k_sigma = 3.0
+        elif tok.startswith("step:") and tok.endswith("sigma"):
+            try:
+                k_sigma = float(tok[len("step:"):-len("sigma")])
+            except ValueError:
+                raise MXNetError(
+                    "MXNET_SENTINEL: %r is not step:<k>sigma" % tok)
+            if k_sigma <= 0:
+                raise MXNetError(
+                    "MXNET_SENTINEL: k must be > 0 in %r" % tok)
+        else:
+            raise MXNetError(
+                "MXNET_SENTINEL: unknown token %r (want step:<k>sigma "
+                "and/or hbm, optionally ending in ':raise')" % tok)
+    return k_sigma, hbm, mode
+
+
+def arm(spec="step:3sigma", mode=None):
+    """Arm the sentinel.  ``spec`` is the ``MXNET_SENTINEL`` grammar
+    (``step:<k>sigma``, ``hbm``, or both, optionally ``:raise``); ``mode``
+    overrides the suffix.  Arming also arms per-program HBM attribution
+    (``sanitize.hbm_arm``) and — when neither full telemetry nor the
+    flight recorder is recording — the flight-recorder ring, so anomaly
+    bundles carry a timeline without anyone pre-arming telemetry."""
+    global _on, _detect, _mode, _k_sigma, _consec_k, _warmup, _alpha, \
+        _armed_fr
+    k_sigma, _hbm, spec_mode = _parse_spec(spec)
+    mode = mode or spec_mode
+    if mode not in ("warn", "raise"):
+        raise MXNetError("sentinel.arm: mode must be 'warn' or 'raise'")
+    if k_sigma is None and not _hbm:
+        return False
+    disarm()
+    with _lock:
+        _mode = mode
+        _detect = k_sigma is not None
+        _k_sigma = k_sigma if k_sigma is not None else 3.0
+        _consec_k = _knob(get_env("MXNET_SENTINEL_CONSEC"), 5, int, 1)
+        _warmup = _knob(get_env("MXNET_SENTINEL_WARMUP"), 16, int, 1)
+        _alpha = min(1.0, _knob(get_env("MXNET_SENTINEL_ALPHA"),
+                                0.05, float, 1e-4))
+        _on = True
+    from . import sanitize as _san
+    _san.hbm_arm()
+    if not _tel._enabled:
+        _tel._fr_arm(_FR_CAP)
+        _armed_fr = True
+        try:
+            from . import diagnostics as _diag
+            _diag._fr_wire()   # crash/SIGTERM ring-flush triggers
+        except Exception:
+            pass
+    return True
+
+
+def disarm():
+    """Return to the strict-no-op state and release anything arm()
+    acquired (HBM capture; the flight recorder, if this module armed it).
+    Recorded baselines are cleared.  Idempotent."""
+    global _on, _detect, _armed_fr
+    was_on, was_fr = _on, _armed_fr
+    with _lock:
+        _on = False
+        _detect = False
+        _armed_fr = False
+    if was_on:
+        from . import sanitize as _san
+        _san.hbm_disarm()
+    if was_fr:
+        _tel._fr_disarm()
+    reset()
+
+
+def reset():
+    """Clear the rolling baselines and anomaly state (test helper; the
+    armed configuration survives)."""
+    global _steps, _last, _consec, _suppress, _last_marker, _anomalies, \
+        _last_anomaly, _last_wire
+    with _lock:
+        _steps = 0
+        _ewma.clear()
+        _warm_buf.clear()
+        _last = None
+        _consec = 0
+        _suppress = 0
+        _last_marker = None
+        _anomalies = 0
+        _last_anomaly = None
+        _last_wire = None
+
+
+def note_recompile(marker):
+    """A legitimate recompile wave was declared
+    (``sanitize.expect_recompile``): re-open the warmup quiet window so
+    the re-trace's slow steps never count as an anomaly.  Baselines are
+    KEPT — post-wave steps still compare against pre-wave state, exactly
+    like mxsan keeps its warm keys.  No-op while disarmed."""
+    global _suppress, _consec, _last_marker
+    if not _on:
+        return
+    with _lock:
+        _suppress = max(_suppress, _warmup)
+        _consec = 0
+        _last_marker = str(marker)
+
+
+def _wire_total():
+    """Current wire-bytes ledger total (metadata only, never a sync)."""
+    from . import sanitize as _san
+    try:
+        return sum(_san._wire_bytes.values())
+    except Exception:
+        return 0
+
+
+def step_close(total_s, data_wait_s, compute_s, epoch=None, nbatch=None):
+    """Fold one completed fit step into the rolling baseline and run the
+    anomaly check.  Called by ``Module.fit`` at step close, next to the
+    ``step`` span — call sites guard with ``if sentinel._on:`` so the
+    disarmed loop body is byte-for-byte the original."""
+    if not _on or not _detect:
+        return
+    global _steps, _consec, _suppress, _last, _last_wire, _anomalies, \
+        _last_anomaly
+    wire = _wire_total()
+    anomaly = None
+    with _lock:
+        comm_mb = 0.0 if _last_wire is None \
+            else max(0.0, (wire - _last_wire) / 1e6)
+        _last_wire = wire
+        row = {"step": float(total_s),
+               "data_wait": float(data_wait_s),
+               "compute": float(compute_s),
+               "comm_mb": comm_mb,
+               "stall": max(0.0, float(total_s) - float(data_wait_s)
+                            - float(compute_s)),
+               "epoch": epoch, "nbatch": nbatch}
+        _last = row
+        # z-scores against the baseline BEFORE this sample folds in (a
+        # rolling baseline that ate the anomalous step first would chase
+        # its own regression)
+        zscores = None
+        if _suppress > 0:
+            _suppress -= 1
+        elif _steps >= _warmup:
+            zscores = {}
+            for s in _SERIES:
+                mean, var = _ewma[s]
+                sigma = max(math.sqrt(max(var, 0.0)),
+                            _SIGMA_REL_FLOOR * abs(mean),
+                            _SIGMA_ABS_FLOOR)
+                zscores[s] = (row[s] - mean) / sigma
+        # an over-threshold sample is QUARANTINED from the fold: letting
+        # it in would inflate the EWM variance step by step and a
+        # sustained slowdown could dodge the K-consecutive trigger by
+        # poisoning its own baseline.  A true level shift still
+        # converges: once the anomaly fires, the post-fire quiet window
+        # folds unconditionally, adapting the baseline to the new level.
+        if zscores is None or zscores["step"] <= _k_sigma:
+            if _steps < _warmup:
+                # the warmup window is an ESTIMATION buffer, not an EWMA
+                # ramp: the baseline is re-seeded from its median + MAD
+                # every step, so the first step's compile time (often
+                # 100x the steady step) is an ignored outlier instead of
+                # a mean the whole run drags behind
+                for s in _SERIES:
+                    buf = _warm_buf.setdefault(s, [])
+                    buf.append(row[s])
+                    med = _median(buf)
+                    sigma = 1.4826 * _median([abs(v - med) for v in buf])
+                    _ewma[s] = [med, sigma * sigma]
+                if _steps + 1 >= _warmup:
+                    _warm_buf.clear()
+            else:
+                for s in _SERIES:
+                    st = _ewma.get(s)
+                    if st is None:
+                        _ewma[s] = [row[s], 0.0]
+                    else:
+                        d = row[s] - st[0]
+                        st[0] += _alpha * d
+                        st[1] = (1.0 - _alpha) * (st[1] + _alpha * d * d)
+        _steps += 1
+        if zscores is None:
+            pass
+        elif zscores["step"] > _k_sigma:
+            _consec += 1
+            if _consec >= _consec_k:
+                dom = max(PHASES, key=lambda p: zscores[p])
+                _anomalies += 1
+                anomaly = _last_anomaly = {
+                    "phase": dom, "k_sigma": _k_sigma,
+                    "consecutive": _consec, "zscores": dict(zscores),
+                    "anatomy": dict(row),
+                    "baseline": {s: {"mean": _ewma[s][0],
+                                     "sigma": math.sqrt(max(_ewma[s][1],
+                                                            0.0))}
+                                 for s in _SERIES},
+                    "steps": _steps,
+                    "suppressed_marker": _last_marker,
+                }
+                _consec = 0
+                _suppress = _warmup   # quiet window: one finding per wave
+        else:
+            _consec = 0
+    if anomaly is not None:
+        _fire(anomaly)
+
+
+def _fire(anomaly):
+    """Emit one anomaly: telemetry event, diagnostics bundle, then warn
+    or raise.  Runs outside the state lock (the bundle write reads
+    telemetry, dist and mxsan state)."""
+    if _tel._enabled:
+        _tel.counter("perf_anomaly", phase=anomaly["phase"])
+        _tel.gauge("perf_anomaly_zscore",
+                   round(anomaly["zscores"]["step"], 3),
+                   phase=anomaly["phase"])
+    path = None
+    try:
+        from . import diagnostics as _diag
+        path = _diag.write_snapshot("perf_anomaly",
+                                    extra={"perf_anomaly": anomaly})
+    except Exception:   # the sentinel must never add a second failure
+        pass
+    row = anomaly["anatomy"]
+    msg = ("mxtpu SENTINEL: step time %.1f ms is %.1f sigma over the "
+           "rolling baseline (%.1f ms) for %d consecutive step(s) — "
+           "dominant divergent phase '%s' (z=%.1f) at epoch=%s nbatch=%s"
+           "%s"
+           % (row["step"] * 1e3, anomaly["zscores"]["step"],
+              anomaly["baseline"]["step"]["mean"] * 1e3,
+              anomaly["consecutive"], anomaly["phase"],
+              anomaly["zscores"][anomaly["phase"]],
+              row.get("epoch"), row.get("nbatch"),
+              "; diagnostics written to %s" % path if path else ""))
+    if _mode == "raise":
+        raise SentinelError(msg)
+    warnings.warn(msg, SentinelWarning, stacklevel=3)
+
+
+# ------------------------------------------------------------- introspection
+def anatomy():
+    """Rolling per-phase baseline state: ``{series: {"mean", "sigma"}}``
+    plus the fold count — the diagnostics-bundle row and the substrate of
+    :func:`digest`.  None before the first step (or while disarmed)."""
+    with _lock:
+        if not _steps:
+            return None
+        out = {s: {"mean": _ewma[s][0],
+                   "sigma": math.sqrt(max(_ewma[s][1], 0.0))}
+               for s in _SERIES if s in _ewma}
+        return {"steps": _steps, "series": out,
+                "anomalies": _anomalies, "suppress": _suppress}
+
+
+def last_anatomy():
+    """The last closed step's raw phase row, or None."""
+    with _lock:
+        return dict(_last) if _last is not None else None
+
+
+def last_anomaly():
+    """The most recent fired anomaly record, or None."""
+    with _lock:
+        return dict(_last_anomaly) if _last_anomaly is not None else None
+
+
+def digest():
+    """Compact step-summary digest for the cross-rank exchange
+    (``parallel.dist._sentinel_exchange``): per-series EWMA means only —
+    a few hundred bytes, shape-free, JSON-safe.  None until the baseline
+    has at least one sample."""
+    with _lock:
+        if not _on or not _detect or not _steps:
+            return None
+        d = {"steps": _steps}
+        for s in _SERIES:
+            if s in _ewma:
+                d[s] = round(_ewma[s][0], 9)
+        return d
+
+
+def _median(values):
+    s = sorted(values)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+# free-running ranks: below this step-time ratio the totals are treated
+# as lockstep-equalised and naming falls through to the self-phase path
+_LOCKSTEP_RATIO = 1.15
+# lockstep naming noise floor: a self-phase excess under this fraction
+# of the peer median step is jitter, not a straggler
+_LOCKSTEP_FLOOR = 0.10
+
+
+def name_straggler(digests):
+    """Name the straggler from a ``{rank: digest}`` map (pure — unit
+    testable with seeded digests): ``(rank, phase, slowdown)`` or None
+    with fewer than two usable digests, a degenerate (zero) peer median,
+    or no attributable excess.
+
+    Two regimes.  When the mean step times genuinely diverge (a
+    free-running fleet, ratio over the peer median ≥ ~1.15), ``rank``
+    holds the largest mean step, ``slowdown`` is that ratio, and
+    ``phase`` is the duration-typed phase with the largest excess over
+    the other ranks' median.  But a synchronous data-parallel fit
+    EQUALISES wall step times — every rank blocks in the collective
+    until the slowest arrives, and that absorbed wait lands in the
+    *waiting* ranks' compute phase (the collective runs inside the fused
+    program), so neither the step total nor a compute excess identifies
+    the culprit.  In that lockstep regime only the host-side self phases
+    (``data_wait``, ``stall``) attribute: the verdict is the rank with
+    the largest such excess, and ``slowdown`` is the step inflation that
+    excess explains (``1 + excess / peer-median step``)."""
+    totals = {r: d["step"] for r, d in digests.items()
+              if isinstance(d, dict) and d.get("step")}
+    if len(totals) < 2:
+        return None
+
+    def _phase_vals(p):
+        return {r: digests[r].get(p) for r in totals
+                if digests[r].get(p) is not None}
+
+    worst = max(sorted(totals), key=lambda r: totals[r])
+    peer_med = _median([v for r, v in totals.items() if r != worst])
+    if peer_med <= 0:
+        return None
+    slowdown = totals[worst] / peer_med
+    if slowdown >= _LOCKSTEP_RATIO:
+        phase, best_excess = "compute", float("-inf")
+        for p in _DURATION_PHASES:
+            vals = _phase_vals(p)
+            if worst not in vals or len(vals) < 2:
+                continue
+            excess = vals[worst] - _median([v for r, v in vals.items()
+                                            if r != worst])
+            if excess > best_excess:
+                phase, best_excess = p, excess
+        return int(worst), phase, float(slowdown)
+
+    # lockstep: name by the largest self-attributable phase excess
+    best = None       # (rank, phase, excess, peer_med_step)
+    for p in ("data_wait", "stall"):
+        vals = _phase_vals(p)
+        if len(vals) < 2:
+            continue
+        for r, v in vals.items():
+            excess = v - _median([pv for pr, pv in vals.items()
+                                  if pr != r])
+            if best is None or excess > best[2]:
+                pm = _median([totals[pr] for pr in totals if pr != r])
+                best = (r, p, excess, pm)
+    if best is None or best[3] <= 0 or best[2] <= _LOCKSTEP_FLOOR * best[3]:
+        return None
+    rank, phase, excess, pm = best
+    return int(rank), phase, float(1.0 + excess / pm)
+
+
+# ------------------------------------------------- autostart (env contract)
+def _autostart():
+    """``MXNET_SENTINEL=step:<k>sigma[,hbm][:raise]`` arms the sentinel
+    at import time.  No threads, no files, no sockets (the ring it may
+    arm is in-memory).  A malformed value degrades to
+    disabled-with-a-warning rather than failing the import; unset is a
+    strict no-op."""
+    raw = get_env("MXNET_SENTINEL")
+    if not raw:
+        return False
+    try:
+        return arm(raw)
+    except MXNetError as e:
+        warnings.warn("MXNET_SENTINEL=%r: %s; sentinel disabled"
+                      % (raw, e))
+        return False
+
+
+_autostart()

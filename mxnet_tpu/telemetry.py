@@ -509,6 +509,25 @@ def gauges():
         return dict(_gauges)
 
 
+def registry_snapshot():
+    """All four registries under ONE lock acquisition:
+    ``{"counters", "gauges", "histograms", "scalars"}``.  The separate
+    ``counters()``/``gauges()``/... accessors each lock independently, so
+    a scraper stitching them together can observe a torn step — counters
+    from step N, gauges from step N+1.  metrics_server builds its
+    ``/metrics.json`` document from this snapshot so one scrape is one
+    consistent point in time."""
+    with _lock:
+        return {
+            "counters": dict(_counters),
+            "gauges": dict(_gauges),
+            "histograms": {name: _hist_export(h)
+                           for name, h in _histograms.items()},
+            "scalars": {k: {"n": s[0], "step": s[1], "value": s[2]}
+                        for k, s in _scalars.items()},
+        }
+
+
 def events():
     """Snapshot of buffered (not yet flushed) events."""
     with _lock:

@@ -1,0 +1,140 @@
+"""2-process live-straggler e2e under ``MXNET_SAN=all:raise``: the
+wrapping test arms ``MXNET_SENTINEL=step:3sigma`` and telemetry, and
+rank 1's data iterator sleeps on every fetch — a pure input-starvation
+straggler.  Each barrier entry exchanges the per-rank sentinel digests
+over the coordination service (key-value RPC only — the collective
+ledger and hash chain stay quiet), so EVERY rank must name rank 1 and
+the ``data_wait`` phase live, mid-run, within a handful of steps.  The
+machine-readable evidence rides one ``OBS rank`` line per rank.
+
+Run via the launcher (the wrapping test sets the env):
+    JAX_PLATFORMS=cpu MXNET_SAN=all:raise MXNET_SENTINEL=step:3sigma \
+        MXNET_TELEMETRY=/tmp/t.jsonl MXNET_DEVICE_PREFETCH=0 \
+        python tools/launch.py -n 2 \
+        python tests/python/dist/dist_sentinel_straggler.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+from mxnet_tpu.parallel import dist  # noqa: E402
+
+dist.init_process_group()
+
+import numpy as np  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sanitize as san  # noqa: E402
+from mxnet_tpu import sentinel as sen  # noqa: E402
+from mxnet_tpu import telemetry as tel  # noqa: E402
+from mxnet_tpu import models  # noqa: E402
+
+SLEEP_S = 0.25     # rank 1's injected per-fetch stall (slowdown is
+                   # 1 + sleep/(compute + sleep): the peers' absorbed
+                   # wait inflates their median step too, so the stall
+                   # must dwarf the ~100 ms CPU compute to clear 1.5x)
+K_STEPS = 8        # the verdict must exist within this many steps
+
+
+class SlowIter:
+    """Delegating iterator that stalls in the fetch — the injected
+    data_wait straggler."""
+
+    def __init__(self, inner, delay_s):
+        self._inner = inner
+        self._delay_s = delay_s
+
+    def __iter__(self):
+        it = iter(self._inner)
+        while True:
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            time.sleep(self._delay_s)
+            yield batch
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def main():
+    assert san.armed() == frozenset(san.CHECKERS), san.armed()
+    assert tel.enabled(), "wrapping test must set MXNET_TELEMETRY"
+    assert sen.armed() and sen._detect, \
+        "wrapping test must set MXNET_SENTINEL=step:<k>sigma"
+    rank, world = dist.rank(), dist.num_workers()
+    rng = np.random.RandomState(0)  # same on every worker
+    n, nc, dim = 200, 4, 16
+    centers = rng.randn(nc, dim) * 3
+    y = rng.randint(0, nc, n)
+    x = (centers[y] + rng.randn(n, dim)).astype(np.float32)
+    shard = slice(rank * n // world, (rank + 1) * n // world)
+    it = mx.io.NDArrayIter(x[shard], y[shard].astype(np.float32),
+                           batch_size=25)
+    if rank == 1:
+        it = SlowIter(it, SLEEP_S)
+
+    # every batch boundary is an exchange point: a barrier entry
+    # publishes this rank's digest and reads the peers', so the
+    # straggler verdict refreshes live while the fit runs
+    live = {"first_step": None, "verdicts": 0, "named": 0, "steps": 0}
+
+    def exchange_and_probe(param):
+        live["steps"] += 1
+        dist.barrier("sent-%d-%d" % (param.epoch, param.nbatch))
+        v = dist.straggler()
+        if v is None:
+            return
+        live["verdicts"] += 1
+        if live["first_step"] is None:
+            live["first_step"] = live["steps"]
+        srank, phase, slowdown = v
+        if srank == 1 and phase == "data_wait":
+            live["named"] += 1
+
+    mx.random.seed(7)  # identical init on every worker
+    mod = mx.Module(models.get_mlp(num_classes=nc), context=mx.cpu())
+    mod.fit(it, num_epoch=6, kvstore="dist_tpu", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            batch_end_callback=exchange_and_probe)
+
+    # live naming: the verdict existed within K steps of the first
+    # exchange, and EVERY rank (this one included) named rank 1's
+    # data_wait — not just the slow rank itself
+    assert live["first_step"] is not None, "no verdict ever formed"
+    assert live["first_step"] <= K_STEPS, live
+    assert live["verdicts"] > 0 and live["named"] == live["verdicts"], live
+
+    v = dist.straggler()
+    assert v is not None, "verdict lost after the fit"
+    srank, phase, slowdown = v
+    assert srank == 1, v
+    assert phase == "data_wait", v
+    assert slowdown > 1.5, v
+
+    # the verdict rode telemetry onto the live endpoint's gauges
+    g = tel.gauges()
+    assert any(k.startswith("straggler_rank") for k in g), g
+    assert any(k.startswith("straggler_slowdown") for k in g), g
+
+    # clean under all:raise — the digest exchange stayed off the
+    # collective ledger (KV RPC only), so the chain verified end to end
+    s = san.stats()
+    for k in ("collective_violations", "sync_violations",
+              "donate_violations", "recompile_violations"):
+        assert s[k] == 0, (k, s, san.violations())
+    st = san.collective_state()
+    assert st["exchanges"] > 0, "hash chain never exchanged"
+
+    print("OBS rank %d first_step %d verdict %s"
+          % (rank, live["first_step"],
+             json.dumps({"rank": srank, "phase": phase,
+                         "slowdown": round(slowdown, 3)})))
+    print("OK rank %d" % rank)
+
+
+if __name__ == "__main__":
+    main()

@@ -919,3 +919,250 @@ def test_flight_recorder_kill_rank_e2e(tmp_path):
     assert span_pids == {0, 1}
     # Perfetto-loadable: a plain JSON object with a traceEvents list
     json.dumps(merged)
+
+
+# ------------------------------------------------------ snapshot atomicity
+def test_metrics_snapshot_atomic_under_concurrent_scrapes():
+    """A scrape is ONE consistent point in time: a writer mutates a
+    counter and a gauge together under the registry lock while scrapers
+    hammer both endpoint formats — every observed pair must agree.
+    Stitching the registries from separate lock acquisitions (the bug
+    ``registry_snapshot()`` exists for) tears within a few hundred
+    iterations."""
+    tel.start()
+    port = ms.start_server(0)
+    stop = threading.Event()
+    tears = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            with tel._lock:
+                tel._counters["atomic_probe"] = i
+                tel._gauges["atomic_probe_twin"] = float(i)
+
+    def scraper():
+        while not stop.is_set():
+            doc = ms.json_snapshot()
+            c = doc["counters"].get("atomic_probe")
+            g = doc["gauges"].get("atomic_probe_twin")
+            if c is not None and g != float(c):
+                tears.append(("json", c, g))
+
+    threads = [threading.Thread(target=writer, daemon=True),
+               threading.Thread(target=scraper, daemon=True)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.time() + 8
+        scrapes = 0
+        while time.time() < deadline and scrapes < 150:
+            doc = json.loads(_http_get(port, "/metrics.json"))
+            c = doc["counters"].get("atomic_probe")
+            g = doc["gauges"].get("atomic_probe_twin")
+            if c is None:
+                continue
+            scrapes += 1
+            if g != float(c):
+                tears.append(("http", c, g))
+            # the Prometheus exposition renders from the same snapshot
+            text = _http_get(port, "/metrics")
+            vals = {}
+            for line in text.splitlines():
+                if line.startswith("mxtpu_atomic_probe_total "):
+                    vals["c"] = float(line.rsplit(" ", 1)[1])
+                elif line.startswith("mxtpu_atomic_probe_twin "):
+                    vals["g"] = float(line.rsplit(" ", 1)[1])
+            if len(vals) == 2 and vals["c"] != vals["g"]:
+                tears.append(("prom", vals["c"], vals["g"]))
+        assert scrapes >= 150, "endpoint never served the probe pair"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+    assert tears == [], tears[:5]
+
+
+# --------------------------------------------------------- agg time windows
+def test_agg_since_window_drops_old_steps(tmp_path, capsys):
+    """``--since`` rebuilds every table from the windowed stream only:
+    the early slow phase disappears from the step histogram, the summary
+    totals are dropped (they cover the whole run), and the window is
+    named in both renderings."""
+    agg = _load_tool("telemetry_agg")
+    base = str(tmp_path / "t.jsonl")
+    cut_s = 1_700_000_100.0          # window boundary, seconds
+    for rank in (0, 1):
+        tel.start("%s.rank%d" % (base, rank))
+        for i in range(20):          # old regime: 50 ms steps, pre-cut
+            tel.record_span("step", cut_s - 100.0 + i, 0.050, cat="step",
+                            epoch=0, nbatch=i, mirror=False)
+        for i in range(20):          # new regime: 10 ms steps, post-cut
+            tel.record_span("step", cut_s + i, 0.010, cat="step",
+                            epoch=1, nbatch=i, mirror=False)
+        tel.counter("fit_samples", 400)
+        tel.stop()
+    files = agg.rank_files(base)
+    whole = agg.aggregate(files)
+    assert whole["histograms"]["step"]["count"] == 80
+    assert whole["counters"]["fit_samples"] == 800   # from the summaries
+    win = agg.aggregate(files, since_us=cut_s * 1e6)
+    assert win["histograms"]["step"]["count"] == 40
+    # only the 10 ms regime is left — the old tail is gone
+    assert win["histograms"]["step"]["max"] == pytest.approx(
+        10_000.0, rel=0.05)
+    # the summary was dropped, but the stream's own cumulative counter
+    # events sit in-window (written at stop time) and still fold — the
+    # histogram halving above is the proof the tables were rebuilt from
+    # the windowed stream, not the summary
+    assert win["counters"]["fit_samples"] == 800
+    assert agg.main([base, "--since", "%f" % cut_s]) == 0
+    out = capsys.readouterr().out
+    assert "window: since" in out and "summaries dropped" in out
+    assert agg.main([base, "--since", "%f" % cut_s, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["window"]["since"] == pytest.approx(cut_s)
+    assert doc["histograms"]["step"]["count"] == 40
+
+
+def test_agg_last_n_steps_window_and_anatomy(tmp_path, capsys):
+    """``--last N`` anchors at each rank's N-th-from-last step span, and
+    the step-anatomy verdict describes ONLY the window: a straggler that
+    recovered mid-run vanishes from ``--last``, while the whole-run view
+    still flags it."""
+    agg = _load_tool("telemetry_agg")
+    base = str(tmp_path / "t.jsonl")
+    t0 = 1_700_000_000.0
+    for rank in (0, 1):
+        tel.start("%s.rank%d" % (base, rank))
+        for i in range(30):
+            # rank 1's first 15 steps are 3x slow (data_wait), then both
+            # ranks agree at 10 ms
+            slow = rank == 1 and i < 15
+            step_s = 0.030 if slow else 0.010
+            tel.record_span("step", t0 + i, step_s, cat="step",
+                            epoch=0, nbatch=i, mirror=False)
+            tel.record_span("data_wait", t0 + i,
+                            0.021 if slow else 0.001,
+                            cat="step", mirror=False)
+            tel.record_span("fused_step", t0 + i, 0.009, cat="step",
+                            mirror=False)
+        tel.stop()
+    files = agg.rank_files(base)
+    whole = agg.aggregate(files)
+    assert whole["anatomy"]["straggler"] == 1
+    assert whole["anatomy"]["slow_phase"] == "data_wait"
+    tail = agg.aggregate(files, last_steps=10)
+    assert tail["histograms"]["step"]["count"] == 20
+    assert tail["anatomy"]["straggler"] is None   # it recovered
+    assert tail["anatomy"]["skew_ratio"] == pytest.approx(1.0, rel=0.05)
+    assert agg.main([base, "--last", "10"]) == 0
+    out = capsys.readouterr().out
+    assert "window: last 10 step(s)" in out
+    assert "STRAGGLER" not in out
+    # degenerate flag value: loud one-line error, not a traceback
+    assert agg.main([base, "--last", "0"]) == 1
+    assert "--last must be positive" in capsys.readouterr().err
+    # --since composes with --last (both windows apply)
+    assert agg.main([base, "--since", "%f" % t0, "--last", "5",
+                     "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["window"] == {"since": pytest.approx(t0), "last": 5}
+    assert doc["histograms"]["step"]["count"] == 10
+
+
+# ------------------------------------------------- degenerate trace inputs
+def test_trace_merge_degenerate_inputs(tmp_path, capsys):
+    """Regression pins for the empty-input family: a zero-event JSONL, an
+    empty file, a bundle with an empty flight-recorder ring, and a JSON
+    document that isn't a bundle all merge into a VALID empty chrome
+    trace (rc 0) with one named warning per degenerate stream — they
+    used to crash the merge."""
+    tm = _load_tool("trace_merge")
+    base = str(tmp_path / "t.jsonl")
+    # rank 0: one real span so the merged doc has content
+    with open(base + ".rank0", "w") as f:
+        f.write(json.dumps(_span_ev("step", 5e8, 9_000.0)) + "\n")
+    # rank 1: zero-event stream (blank lines + non-dict JSON lines only)
+    with open(base + ".rank1", "w") as f:
+        f.write("\n[]\n42\n")
+    # rank 2: completely empty file
+    open(base + ".rank2", "w").close()
+    doc, notes = tm.merge_paths([base + ".rank%d" % r for r in (0, 1, 2)])
+    by_rank = {n["rank"]: n for n in notes}
+    assert by_rank[0]["warning"] is None
+    assert "zero-event telemetry stream" in by_rank[1]["warning"]
+    assert "zero-event telemetry stream" in by_rank[2]["warning"]
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == 1 and spans[0]["pid"] == 0
+    # empty-ring bundle: valid, warned, zero spans
+    bundle = {"type": "mxtpu_diagnostics", "reason": "probe", "rank": "3",
+              "flight_recorder": {"capacity": 64, "recorded": 0,
+                                  "events": []}}
+    bpath = tmp_path / "mxtpu_diag.probe.pid1.rank3.json"
+    bpath.write_text(json.dumps(bundle) + "\n")
+    doc2, notes2 = tm.merge_paths([str(bpath)])
+    assert "empty flight-recorder ring" in notes2[0]["warning"]
+    assert doc2["traceEvents"] == [e for e in doc2["traceEvents"]
+                                   if e["ph"] == "M"]
+    json.dumps(doc2)                  # still a loadable chrome trace
+    # a JSON document that isn't a diagnostics bundle: named, not crashed
+    odd = tmp_path / "odd.rank4.json"
+    odd.write_text("{}\n")
+    _, notes3 = tm.merge_paths([str(odd)])
+    assert "not an mxnet_tpu diagnostics bundle" in notes3[0]["warning"]
+    # CLI: rc 0, warnings on stderr, output file is a valid empty trace
+    out = tmp_path / "fleet.trace.json"
+    assert tm.main([base + ".rank2", "-o", str(out)]) == 0
+    err = capsys.readouterr().err
+    assert "trace_merge: warning:" in err
+    assert "zero-event telemetry stream" in err
+    merged = json.loads(out.read_text())
+    assert isinstance(merged["traceEvents"], list)
+
+
+# ------------------------------------------------------- live sentinel e2e
+@pytest.mark.slow
+@pytest.mark.timeout(300)
+def test_dist_sentinel_names_straggler_live(tmp_path):
+    """THE live-sentinel acceptance: a 2-process dist fit with rank 1's
+    data iterator artificially stalled — within K steps EVERY rank's
+    ``dist.straggler()`` names rank 1 AND the data_wait phase mid-run
+    (digests ride the coordination KV at barrier entries), all under
+    ``MXNET_SAN=all:raise`` with zero collective-ledger violations."""
+    import re
+    import subprocess
+    import sys
+    tfile = str(tmp_path / "t.jsonl")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXNET_SAN"] = "all:raise"
+    env["MXNET_SENTINEL"] = "step:3sigma"
+    env["MXNET_TELEMETRY"] = tfile
+    env["MXNET_DEVICE_PREFETCH"] = "0"   # keep the stall in data_wait
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "launch.py"), "-n", "2",
+         sys.executable, str(ROOT / "tests" / "python" / "dist" /
+                             "dist_sentinel_straggler.py")],
+        env=env, cwd=str(ROOT), capture_output=True, text=True, timeout=280)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    assert out.count("OK rank") == 2, out[-3000:]
+    obs = re.findall(r"OBS rank (\d) first_step (\d+) verdict (.*)",
+                     proc.stdout)
+    assert {r for r, _, _ in obs} == {"0", "1"}, proc.stdout
+    for rank, first_step, verdict_json in obs:
+        # named LIVE: the verdict existed within a handful of steps
+        assert int(first_step) <= 8, (rank, first_step)
+        v = json.loads(verdict_json)
+        assert v["rank"] == 1, (rank, v)
+        assert v["phase"] == "data_wait", (rank, v)
+        assert v["slowdown"] > 1.5, (rank, v)
+    # the verdict rode telemetry into both rank streams as gauges
+    agg = _load_tool("telemetry_agg")
+    merged = agg.aggregate(agg.rank_files(tfile))
+    for rank in (0, 1):
+        g = merged["gauges_by_rank"][rank]
+        assert any(k.startswith("straggler_rank") for k in g), g

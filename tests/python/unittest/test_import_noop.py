@@ -100,6 +100,18 @@ assert _dist.clock_offset() is None, "clock offset estimated"
 # dispatch-site gates are off)
 assert _san.wire_bytes() == {}, "wire-bytes ledger grew while disarmed"
 
+# performance sentinel: with MXNET_SENTINEL unset there is no baseline,
+# no detection state, no HBM capture, and no digest exchange — every
+# hot-path entry is one bool read
+import mxnet_tpu.sentinel as _sen
+assert _sen._on is False, "sentinel armed"
+assert _sen._steps == 0, "sentinel folded a step"
+assert _sen.anatomy() is None and _sen.last_anomaly() is None
+assert _san._hbm_on is False, "HBM attribution armed"
+assert _san.hbm_ledger() == {}, "HBM ledger grew while disarmed"
+assert _dist._sent_seq == 0, "sentinel digest exchange advanced"
+assert _dist.straggler() is None, "straggler verdict exists"
+
 new_threads = [t.name for t in threading.enumerate()
                if t.ident not in baseline_threads]
 print("RESULT " + json.dumps({"threads": new_threads, **created}))

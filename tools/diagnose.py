@@ -141,6 +141,56 @@ def render(bundle, out=sys.stdout, events=10, stacks=True):
                          last.get("nbatch"), last.get("step"),
                          last.get("seconds")))
 
+    sen = bundle.get("sentinel")
+    if sen:
+        out.write("\nLive sentinel\n")
+        an = sen.get("anatomy") or {}
+        if an.get("series"):
+            out.write("  baseline (%s steps, %s anomalies)\n"
+                      % (an.get("steps"), an.get("anomalies")))
+            for name, st in sorted(an["series"].items()):
+                if name == "comm_mb":
+                    out.write("    %-12s %10.3f mb   +/- %.3f\n"
+                              % (name, st.get("mean", 0.0),
+                                 st.get("sigma", 0.0)))
+                else:
+                    out.write("    %-12s %10.2f ms   +/- %.2f\n"
+                              % (name, st.get("mean", 0.0) * 1e3,
+                                 st.get("sigma", 0.0) * 1e3))
+        last = sen.get("last_step") or {}
+        if last:
+            out.write("  last step    %s\n"
+                      % "  ".join("%s=%s" % (k, v)
+                                  for k, v in sorted(last.items())))
+        anom = sen.get("last_anomaly")
+        if anom:
+            out.write("  ANOMALY      phase %s  z=%.1f (k=%s, %s "
+                      "consecutive)\n"
+                      % (anom.get("phase"),
+                         (anom.get("zscores") or {}).get("step", 0.0),
+                         anom.get("k_sigma"), anom.get("consecutive")))
+        straggler = sen.get("straggler")
+        if straggler:
+            out.write("  straggler    rank %s  phase %s  %.2fx\n"
+                      % (straggler[0], straggler[1], straggler[2]))
+
+    hbm = bundle.get("hbm")
+    if hbm:
+        out.write("\nHBM attribution (per compiled program)\n")
+        rows = sorted(hbm.items(), key=lambda kv: -kv[1].get("total", 0))
+        for name, row in rows:
+            out.write("  %-32s %10.2f MB  (args %.2f, out %.2f, "
+                      "temps %.2f, code %.2f, alias -%.2f)\n"
+                      % (name, row.get("total", 0) / 1e6,
+                         row.get("args", 0) / 1e6,
+                         row.get("outputs", 0) / 1e6,
+                         row.get("temps", 0) / 1e6,
+                         row.get("generated_code", 0) / 1e6,
+                         row.get("alias", 0) / 1e6))
+        out.write("  %-32s %10.2f MB\n"
+                  % ("TOTAL", sum(r.get("total", 0)
+                                  for r in hbm.values()) / 1e6))
+
     fr = bundle.get("flight_recorder")
     if fr:
         out.write("\nFlight recorder (ring of %s, %s recorded)\n"
@@ -195,6 +245,29 @@ def render(bundle, out=sys.stdout, events=10, stacks=True):
                              ev.get("total", ev.get("value")), desc))
 
 
+def json_doc(bundle, events=10, stacks=True):
+    """Machine-readable rendering: the validated bundle with the SAME
+    trimming the text renderer applies (--events tail length, --no-stacks)
+    so CI asserts on exactly what a human would have seen.  Mirrors
+    ``telemetry_report --json``."""
+    doc = dict(bundle)
+    if not stacks:
+        doc["threads"] = [{k: v for k, v in t.items() if k != "stack"}
+                          for t in doc.get("threads") or []]
+    n = max(events, 0)
+    tel = doc.get("telemetry")
+    if isinstance(tel, dict) and tel.get("recent_events"):
+        tel = dict(tel)
+        tel["recent_events"] = tel["recent_events"][-n:] if n else []
+        doc["telemetry"] = tel
+    fr = doc.get("flight_recorder")
+    if isinstance(fr, dict) and fr.get("events"):
+        fr = dict(fr)
+        fr["events"] = fr["events"][-n:] if n else []
+        doc["flight_recorder"] = fr
+    return doc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="diagnostics bundle (JSON)")
@@ -202,12 +275,22 @@ def main(argv=None):
                     help="telemetry tail length to show (default 10)")
     ap.add_argument("--no-stacks", action="store_true",
                     help="omit per-thread stack traces")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the validated bundle as one JSON document "
+                         "(same --events/--no-stacks trimming as the text "
+                         "rendering) for CI assertions")
     args = ap.parse_args(argv)
     try:
         bundle = load_bundle(args.path)
     except (OSError, ValueError) as e:
         sys.stderr.write("diagnose: cannot read %s: %s\n" % (args.path, e))
         return 1
+    if args.json:
+        json.dump(json_doc(bundle, events=args.events,
+                           stacks=not args.no_stacks),
+                  sys.stdout, indent=1, default=str)
+        sys.stdout.write("\n")
+        return 0
     render(bundle, events=args.events, stacks=not args.no_stacks)
     return 0
 

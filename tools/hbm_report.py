@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Render a per-program HBM attribution ledger.
+
+``MXNET_SENTINEL`` (mxnet_tpu/sentinel.py) arms capture-at-compile HBM
+attribution: every jit cache registered through ``sanitize.register_cache``
+records its compiled program's ``memory_analysis()`` byte breakdown —
+argument, output, temp and generated-code bytes, minus donation aliasing —
+into a per-program ledger (``sanitize.hbm_ledger()``).  The ledger rides
+diagnostics bundles as the ``hbm`` section (a device OOM dumps one
+automatically — the ``oom`` bundle) and ``/metrics`` as the
+``hbm_program_bytes`` gauges.  This tool renders it for humans and CI:
+
+    python tools/hbm_report.py mxtpu_diag.oom.pid1234.json
+    python tools/hbm_report.py hbm_ledger.json --json
+    python tools/hbm_report.py bundle.json --top 5
+
+Accepts a diagnostics bundle (reads its ``hbm`` section) or a bare
+ledger JSON document ``{program: {args, outputs, temps, generated_code,
+alias, total}}``.  Rows sort by resident total, descending — the first
+line answers "which program holds the memory".  Pure stdlib.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+FIELDS = ("args", "outputs", "temps", "generated_code", "alias", "total")
+
+
+def load_ledger(path):
+    """Ledger dict from a diagnostics bundle's ``hbm`` section or a bare
+    ledger document.  Raises ValueError when the file is neither."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError("%s: not a JSON object" % path)
+    if doc.get("type") == "mxtpu_diagnostics":
+        ledger = doc.get("hbm")
+        if not ledger:
+            raise ValueError(
+                "%s: diagnostics bundle has no 'hbm' section — was "
+                "MXNET_SENTINEL armed when it was written?" % path)
+        return ledger
+    if all(isinstance(v, dict) and "total" in v for v in doc.values()) \
+            and doc:
+        return doc
+    raise ValueError("%s: neither a diagnostics bundle nor an HBM "
+                     "ledger document" % path)
+
+
+def summarize(ledger):
+    """Sorted rows + fleet totals: ``{"programs": [(name, row)...],
+    "totals": {field: bytes}}``.  Totals sum every field across programs
+    — the cross-check the dryrun's MULTICHIP_HBM record gates on."""
+    rows = sorted(ledger.items(), key=lambda kv: -kv[1].get("total", 0))
+    totals = {f: sum(int(r.get(f, 0)) for _, r in rows) for f in FIELDS}
+    return {"programs": rows, "totals": totals}
+
+
+def render(summary, out=None, top=None):
+    out = sys.stdout if out is None else out
+    rows = summary["programs"]
+    shown = rows[:top] if top else rows
+    out.write("Per-program HBM attribution (%d program(s))\n" % len(rows))
+    out.write("%-36s %10s %10s %10s %10s %10s %10s\n"
+              % ("program", "total_mb", "args_mb", "out_mb", "temps_mb",
+                 "code_mb", "alias_mb"))
+    for name, r in shown:
+        out.write("%-36s %10.2f %10.2f %10.2f %10.2f %10.2f %10.2f\n"
+                  % (name, r.get("total", 0) / 1e6,
+                     r.get("args", 0) / 1e6, r.get("outputs", 0) / 1e6,
+                     r.get("temps", 0) / 1e6,
+                     r.get("generated_code", 0) / 1e6,
+                     r.get("alias", 0) / 1e6))
+    if top and len(rows) > top:
+        out.write("  ... %d more program(s) (--top %d)\n"
+                  % (len(rows) - top, top))
+    t = summary["totals"]
+    out.write("%-36s %10.2f %10.2f %10.2f %10.2f %10.2f %10.2f\n"
+              % ("TOTAL", t["total"] / 1e6, t["args"] / 1e6,
+                 t["outputs"] / 1e6, t["temps"] / 1e6,
+                 t["generated_code"] / 1e6, t["alias"] / 1e6))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="diagnostics bundle or HBM ledger (JSON)")
+    ap.add_argument("--top", type=int, default=None,
+                    help="show only the N largest programs")
+    ap.add_argument("--json", action="store_true",
+                    help="emit {programs, totals} as one JSON document")
+    args = ap.parse_args(argv)
+    try:
+        ledger = load_ledger(args.path)
+    except (OSError, ValueError) as e:
+        sys.stderr.write("hbm_report: %s\n" % e)
+        return 1
+    summary = summarize(ledger)
+    if args.json:
+        json.dump({"programs": [{"name": n, **r}
+                                for n, r in summary["programs"]],
+                   "totals": summary["totals"]},
+                  sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        return 0
+    render(summary, top=args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

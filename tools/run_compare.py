@@ -59,7 +59,12 @@ _DOWN_HINTS = ("loss", "entropy", "err", "perplexity", "mae", "mse",
                # regresses by going up — a sharding change that silently
                # widens a collective shows here (docs/observability.md
                # "wire-bytes accounting")
-               "wire_bytes")
+               "wire_bytes",
+               # per-program HBM attribution: compiled-program resident
+               # bytes regress by going up — a donation break or temp
+               # blow-up shows here before the device OOMs
+               # (docs/observability.md "HBM attribution")
+               "hbm_bytes")
 
 _EVENT_TYPES = ("scalar", "span", "counter", "gauge", "hist", "summary")
 
@@ -218,6 +223,26 @@ def _load_bench(run, doc, path):
         run.groups["wire_bytes"] = names
         if isinstance(wire.get("config"), dict):
             run.identity["wire_bytes"] = dict(wire["config"])
+    # hbm record (dryrun_multichip's per-program HBM attribution,
+    # MULTICHIP_HBM_*): numeric fields are gated headline metrics —
+    # compiled-program resident bytes regress by going UP (the hbm_bytes
+    # direction hint); the nested config block (device count / batch
+    # shape) is IDENTITY, and the per-program breakdown rides under
+    # "programs" as context (rendered by tools/hbm_report.py, not gated
+    # per-row — program names churn with jit cache keys)
+    hbm = rec.get("hbm") if isinstance(rec, dict) else None
+    if isinstance(hbm, dict):
+        names = set()
+        for k, v in hbm.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                run.bench[str(k)] = float(v)
+                names.add(str(k))
+        for name in run.bench:
+            if "hbm_bytes" in name:
+                names.add(name)
+        run.groups["hbm"] = names
+        if isinstance(hbm.get("config"), dict):
+            run.identity["hbm"] = dict(hbm["config"])
     chained = (run.meta or {}).get("telemetry_scalars")
     if chained:
         for candidate in (chained,

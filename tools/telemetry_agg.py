@@ -99,6 +99,38 @@ def load_events(path):
     return events
 
 
+def since_us_of(value):
+    """Normalise a --since timestamp to event-stream µs.  Values below
+    1e12 are treated as seconds-since-epoch (``date +%s``, bundle
+    ``time`` fields); larger values are already µs (event ``ts`` fields)
+    — the two regimes are ~6 orders of magnitude apart, so the split
+    point is unambiguous for any date this side of the year 33000."""
+    value = float(value)
+    return value * 1e6 if value < 1e12 else value
+
+
+def window_events(events, since_us=None, last_steps=None):
+    """Slice one rank's event stream to a time window: events at/after
+    ``since_us`` (µs), and/or only the last ``last_steps`` training steps
+    (anchored at the n-th-from-last ``step`` span's start).  Any active
+    window DROPS the run's summary event — its totals cover the whole
+    run, so keeping it would let whole-run histograms shadow the
+    windowed rebuild (fold_rank prefers summaries by design).  Returns
+    the (possibly) filtered list."""
+    if since_us is None and last_steps is None:
+        return events
+    evs = [ev for ev in events if ev.get("type") != "summary"]
+    if since_us is not None:
+        evs = [ev for ev in evs if float(ev.get("ts", 0)) >= since_us]
+    if last_steps is not None:
+        steps = [ev for ev in evs
+                 if ev.get("type") == "span" and ev.get("name") == "step"]
+        if len(steps) > last_steps:
+            cut = float(steps[-last_steps].get("ts", 0))
+            evs = [ev for ev in evs if float(ev.get("ts", 0)) >= cut]
+    return evs
+
+
 def rank_of(path):
     """Rank from the launch-contract filename suffix, else None."""
     m = re.search(r"\.rank(\d+)$", path)
@@ -461,9 +493,13 @@ def step_anatomy(per_rank, ratio=STRAGGLER_RATIO):
 
 
 # ----------------------------------------------------------------- top level
-def aggregate(paths, skew_spans=SKEW_SPANS, ratio=STRAGGLER_RATIO):
+def aggregate(paths, skew_spans=SKEW_SPANS, ratio=STRAGGLER_RATIO,
+              since_us=None, last_steps=None):
     """Load + merge a set of per-rank files.  Files without a rank suffix
-    get sequential pseudo-ranks so single-file input still renders."""
+    get sequential pseudo-ranks so single-file input still renders.
+    ``since_us``/``last_steps`` window each rank's stream before folding
+    (see :func:`window_events`) — every downstream table, the step
+    anatomy included, then describes only the window."""
     per_rank = {}
     for path in paths:
         rank = rank_of(path)
@@ -471,7 +507,9 @@ def aggregate(paths, skew_spans=SKEW_SPANS, ratio=STRAGGLER_RATIO):
             rank = 0
             while rank in per_rank:
                 rank += 1
-        per_rank[rank] = fold_rank(load_events(path))
+        events = window_events(load_events(path), since_us=since_us,
+                               last_steps=last_steps)
+        per_rank[rank] = fold_rank(events)
         per_rank[rank]["path"] = path
     merged = merge_ranks(per_rank)
     merged["ranks"] = sorted(per_rank)
@@ -491,6 +529,15 @@ def render(agg, out=None):
     ranks = agg["ranks"]
     out.write("Fleet telemetry: %d rank file(s) (%s)\n"
               % (len(ranks), ", ".join("rank%s" % r for r in ranks)))
+    win = agg.get("window")
+    if win:
+        parts = []
+        if win.get("since") is not None:
+            parts.append("since %s" % win["since"])
+        if win.get("last") is not None:
+            parts.append("last %d step(s)" % win["last"])
+        out.write("window: %s — summaries dropped, all tables rebuilt "
+                  "from the windowed stream\n" % ", ".join(parts))
     live = [r for r in ranks if not agg["per_rank"][r]["has_summary"]]
     if live:
         out.write("note: no summary event for rank(s) %s — run still live "
@@ -615,6 +662,15 @@ def main(argv=None):
     ap.add_argument("--straggler-ratio", type=float, default=STRAGGLER_RATIO,
                     help="flag a straggler when slowest/median rank mean "
                          "exceeds this (default %(default)s)")
+    ap.add_argument("--since", metavar="TS", type=float, default=None,
+                    help="window: only events at/after TS — seconds since "
+                         "epoch (date +%%s, bundle 'time' fields) or raw "
+                         "event-stream µs; drops run summaries so every "
+                         "table is rebuilt from the windowed stream")
+    ap.add_argument("--last", metavar="N", type=int, default=None,
+                    help="window: only the last N training steps per rank "
+                         "(anchored at each rank's N-th-from-last 'step' "
+                         "span); composes with --since")
     ap.add_argument("--json", action="store_true",
                     help="emit the merged view as one JSON document")
     ap.add_argument("--timeline", metavar="OUT",
@@ -634,8 +690,16 @@ def main(argv=None):
         sys.stderr.write("telemetry_agg: cannot read %s\n"
                          % ", ".join(missing))
         return 1
+    if args.last is not None and args.last <= 0:
+        sys.stderr.write("telemetry_agg: --last must be positive\n")
+        return 1
     spans = tuple(SKEW_SPANS) + tuple(args.span or ())
-    agg = aggregate(paths, skew_spans=spans, ratio=args.straggler_ratio)
+    agg = aggregate(paths, skew_spans=spans, ratio=args.straggler_ratio,
+                    since_us=(since_us_of(args.since)
+                              if args.since is not None else None),
+                    last_steps=args.last)
+    if args.since is not None or args.last is not None:
+        agg["window"] = {"since": args.since, "last": args.last}
     if args.timeline:
         tm = _sibling("trace_merge")
         doc, _notes = tm.merge_paths(paths)

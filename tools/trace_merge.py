@@ -52,7 +52,14 @@ def load_stream(path):
     Accepts a telemetry JSON-lines file or a diagnostics bundle (the
     flight-recorder ring plus the recent-event tail).  ``offset_sec`` is
     the stream's own ``clock_offset_sec`` estimate (last one recorded),
-    or None when the stream never exchanged clocks."""
+    or None when the stream never exchanged clocks.
+
+    Degenerate inputs — an empty file, a bundle whose flight-recorder
+    ring recorded nothing, a zero-event JSONL, a JSON document that is
+    neither — load as an EMPTY stream carrying a named ``warning``
+    instead of raising: a crashed rank's truncated evidence must still
+    merge into a valid (possibly empty) chrome trace, not kill the whole
+    fleet merge (regression-pinned in test_fleet_observability)."""
     with open(path) as f:
         text = f.read()
     # a diagnostics bundle parses as ONE document; a telemetry JSONL file
@@ -67,21 +74,31 @@ def load_stream(path):
         # a single-line telemetry file is still a one-event stream
         doc = None if "ts" in doc else doc
         if doc is not None:
-            raise ValueError("%s: a JSON document but not an mxnet_tpu "
-                             "diagnostics bundle (type=%r)"
-                             % (path, doc.get("type")))
+            return _empty_stream(
+                path, "a JSON document but not an mxnet_tpu diagnostics "
+                      "bundle (type=%r)" % (doc.get("type"),))
     events = []
     for line in text.splitlines():
         line = line.strip()
         if not line:
             continue
         try:
-            events.append(json.loads(line))
+            ev = json.loads(line)
         except ValueError:
             continue   # partial trailing line of a live run
+        if isinstance(ev, dict):
+            events.append(ev)   # a non-dict line ([], a number) is noise
     rank = rank_of(path)
-    return {"rank": rank, "events": events, "path": path,
-            "offset_sec": _stream_offset(events), "source": "jsonl"}
+    stream = {"rank": rank, "events": events, "path": path,
+              "offset_sec": _stream_offset(events), "source": "jsonl"}
+    if not events:
+        stream["warning"] = "zero-event telemetry stream"
+    return stream
+
+
+def _empty_stream(path, why):
+    return {"rank": rank_of(path), "events": [], "path": path,
+            "offset_sec": None, "source": "jsonl", "warning": why}
 
 
 def _from_bundle(doc, path):
@@ -89,7 +106,9 @@ def _from_bundle(doc, path):
     tel = doc.get("telemetry") or {}
     # the ring is the richer record; a bundle written without the recorder
     # armed still carries the telemetry recent-event tail
-    events = list(fr.get("events") or tel.get("recent_events") or [])
+    events = [ev for ev in (fr.get("events")
+                            or tel.get("recent_events") or [])
+              if isinstance(ev, dict)]
     rank = doc.get("rank")
     try:
         rank = int(rank)
@@ -99,8 +118,12 @@ def _from_bundle(doc, path):
     if offset is None:
         g = (tel.get("gauges") or {}).get(_OFFSET_GAUGE)
         offset = float(g) if isinstance(g, (int, float)) else None
-    return {"rank": rank, "events": events, "path": path,
-            "offset_sec": offset, "source": "bundle"}
+    stream = {"rank": rank, "events": events, "path": path,
+              "offset_sec": offset, "source": "bundle"}
+    if not events:
+        stream["warning"] = ("empty flight-recorder ring and no "
+                             "recent-event tail")
+    return stream
 
 
 def _stream_offset(events):
@@ -147,7 +170,8 @@ def merge(streams):
                       "source": st["source"],
                       "offset_sec": offset if corrected else None,
                       "corrected": corrected,
-                      "events": len(st["events"])})
+                      "events": len(st["events"]),
+                      "warning": st.get("warning")})
         trace_events.append({"ph": "M", "name": "process_name",
                              "pid": rank, "tid": 0,
                              "args": {"name": "rank %d%s"
@@ -228,6 +252,9 @@ def main(argv=None):
             % (n["rank"], n["source"], n["events"],
                "offset %+0.6fs" % n["offset_sec"] if n["corrected"]
                else "no clock_offset_sec — merged uncorrected"))
+        if n.get("warning"):
+            sys.stderr.write("trace_merge: warning: %s: %s\n"
+                             % (n["path"], n["warning"]))
     if args.output:
         with open(args.output, "w") as f:
             json.dump(doc, f, indent=1)
