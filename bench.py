@@ -28,6 +28,14 @@ def bench_resnet50_train(batch=32, image=224, chunk=40, rounds=10,
                             image_shape="3,%d,%d" % (image, image))
     opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
                            rescale_grad=1.0 / batch, wd=1e-4)
+    # cost attribution for the MFU headline: armed only when roofline
+    # peaks resolve (MXNET_PEAK_FLOPS or a real TPU's device-kind
+    # table) — the warmup chunk compile below then captures the fused
+    # program's FLOP count.  Peaks unset keeps this strictly off.
+    from mxnet_tpu import cost as cost_mod
+    from mxnet_tpu import sanitize as san
+    if cost_mod.enabled():
+        san.cost_arm()
     # policy (bench default: the bf16 AMP policy unless MXNET_AMP=0) adds
     # f32 master weights + dynamic loss scaling on top of the bf16 cast
     if policy is not None:
@@ -75,6 +83,16 @@ def bench_resnet50_train(batch=32, image=224, chunk=40, rounds=10,
     dt = time.perf_counter() - t0
     img_per_sec = batch * (chunk + 1) * rounds / dt
 
+    # MFU over the timed region: the captured chunk program's FLOPs
+    # (covers chunk+1 fused steps) times the dispatches, over measured
+    # wall time, against the resolved peak.  None when peaks are unset.
+    mfu = None
+    if cost_mod.enabled():
+        row = next((r for n, r in san.cost_ledger().items()
+                    if n.startswith("train_step.run_steps")), None)
+        if row and row.get("flops"):
+            mfu = cost_mod.mfu(row["flops"] * rounds, dt)
+
     # input-pipeline measurement round (outside the timed region): re-stage
     # the host batch for each chunk through the depth-2 device prefetcher
     # vs synchronously, and stamp the measured data_wait share into the
@@ -82,7 +100,7 @@ def bench_resnet50_train(batch=32, image=224, chunk=40, rounds=10,
     pipeline = measure_data_wait(
         ts, params, state, aux,
         {"data": data, "softmax_label": label}, chunk)
-    return img_per_sec, pipeline
+    return img_per_sec, pipeline, mfu
 
 
 def measure_data_wait(ts, params, state, aux, host_batch, chunk, chunks=2,
@@ -325,16 +343,30 @@ def main():
     # bf16-cast step, MXNET_AMP/MXNET_LOSS_SCALE tune it
     policy = amp_mod.resolve_policy(default=amp_mod.Policy("bfloat16"))
     cfg = dict(batch=32, image=224, chunk=40, rounds=10, dtype="bfloat16")
-    img_per_sec, pipeline = bench_resnet50_train(policy=policy, **cfg)
+    img_per_sec, pipeline, mfu = bench_resnet50_train(policy=policy, **cfg)
     cfg["amp"] = policy.describe() if policy is not None else None
     baseline_p100 = 181.53
+    # efficiency denominators (null-safe: peaks unset -> mfu None, no
+    # cost capture -> compile seconds None) so the perf trajectory
+    # finally carries an MFU next to its img/s headline
+    from mxnet_tpu import sanitize as san
+    comp = san.compile_seconds()
     rec = {
         "metric": "resnet50_train_img_per_sec_b32",
         "value": round(img_per_sec, 2),
         "unit": "img/s",
         "vs_baseline": round(img_per_sec / baseline_p100, 3),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "compile_seconds": comp.get("total") if comp else None,
         "meta": run_meta(cfg),
     }
+    if mfu is not None:
+        # structured twin of the headline fields: run_compare ingests
+        # the cost block's numerics as gated metrics (mfu up-hint,
+        # compile_sec down-hint)
+        rec["cost"] = {"mfu": round(mfu, 4)}
+        if comp:
+            rec["cost"]["compile_sec"] = round(comp["total"], 3)
     summary = telemetry_summary() or {}
     # measured input-pipeline shares (prefetch on vs synchronous staging)
     summary.update(pipeline)

@@ -1,0 +1,132 @@
+"""Roofline peaks and MFU arithmetic for per-program cost attribution.
+
+The cost ledger (:mod:`mxnet_tpu.sanitize`) records what each compiled
+program *costs* — model FLOPs, bytes accessed — but an efficiency claim
+needs a denominator: the hardware's peak FLOP rate and memory bandwidth.
+This module resolves that pair, in order of precedence:
+
+1. ``MXNET_PEAK_FLOPS`` / ``MXNET_PEAK_BW`` — explicit per-chip peaks
+   (FLOP/s and bytes/s; SI suffixes K/M/G/T/P accepted, e.g. ``275T``
+   and ``1228G``).  Either alone is honoured; MFU needs only FLOPS.
+2. On a real TPU backend, the device-kind table below (per-chip dense
+   peak FLOP/s and HBM bandwidth, from published chip specs).
+
+With neither available every consumer degrades to None — the strict
+no-op contract: no gauges, no roofline verdicts, no sentinel MFU watch.
+Nothing here imports or initializes jax at module import; the device
+probe runs only when a caller (the fused fit, diagnostics) asks after
+the backend already exists.
+
+Definitions (docs/observability.md "Cost attribution & MFU"):
+
+- MFU            = (model FLOPs / step seconds) / peak FLOP/s
+- intensity      = program FLOPs / bytes accessed       [FLOP/byte]
+- ridge point    = peak FLOP/s / peak bytes/s           [FLOP/byte]
+- a program is compute-bound when intensity >= ridge, else memory-bound
+"""
+from __future__ import annotations
+
+from .base import get_env
+
+__all__ = ["resolve_peaks", "enabled", "mfu", "ridge", "verdict",
+           "DEVICE_PEAKS"]
+
+# per-chip dense peak FLOP/s (bf16 where the MXU supports it) and HBM
+# bandwidth in bytes/s, keyed by a lowercase substring of
+# ``device.device_kind`` — checked most-specific first
+DEVICE_PEAKS = (
+    ("v5p",      459e12, 2765e9),
+    ("v5 lite",  197e12,  819e9),
+    ("v5e",      197e12,  819e9),
+    ("v4",       275e12, 1228e9),
+    ("v3",       123e12,  900e9),
+    ("v2",        45e12,  700e9),
+)
+
+_SUFFIX = {"k": 1e3, "m": 1e6, "g": 1e9, "t": 1e12, "p": 1e15}
+
+_cache = None             # (peak_flops|None, peak_bw|None) once resolved
+
+
+def _parse_rate(raw):
+    """``'275e12'`` / ``'275T'`` / ``'1228G'`` -> float, None on junk."""
+    if raw is None:
+        return None
+    raw = str(raw).strip()
+    if not raw:
+        return None
+    mult = 1.0
+    if raw[-1].lower() in _SUFFIX:
+        mult = _SUFFIX[raw[-1].lower()]
+        raw = raw[:-1]
+    try:
+        val = float(raw) * mult
+    except ValueError:
+        return None
+    return val if val > 0 else None
+
+
+def _device_peaks():
+    """(peak_flops, peak_bw) from the TPU device-kind table; (None,
+    None) off-TPU or when jax is not importable/initialized yet."""
+    try:
+        import jax
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            return (None, None)
+        kind = str(getattr(dev, "device_kind", "")).lower()
+    except Exception:
+        return (None, None)
+    for key, flops, bw in DEVICE_PEAKS:
+        if key in kind:
+            return (flops, bw)
+    return (None, None)
+
+
+def resolve_peaks(refresh=False):
+    """The active ``(peak_flops, peak_bw)`` pair, each possibly None.
+    Env vars win; the TPU table fills whichever the env left unset.
+    Cached after the first call (``refresh=True`` re-reads — tests)."""
+    global _cache
+    if _cache is not None and not refresh:
+        return _cache
+    flops = _parse_rate(get_env("MXNET_PEAK_FLOPS"))
+    bw = _parse_rate(get_env("MXNET_PEAK_BW"))
+    if flops is None or bw is None:
+        dflops, dbw = _device_peaks()
+        flops = flops if flops is not None else dflops
+        bw = bw if bw is not None else dbw
+    _cache = (flops, bw)
+    return _cache
+
+
+def enabled():
+    """True when a peak FLOP rate is known (MFU is computable)."""
+    return resolve_peaks()[0] is not None
+
+
+def mfu(flops, seconds):
+    """Model-FLOP utilization of one step, or None when peaks are unset
+    or the inputs don't define a rate."""
+    peak = resolve_peaks()[0]
+    if peak is None or not flops or not seconds or seconds <= 0:
+        return None
+    return (float(flops) / float(seconds)) / peak
+
+
+def ridge():
+    """The machine ridge point in FLOP/byte, or None without both
+    peaks."""
+    flops, bw = resolve_peaks()
+    if flops is None or bw is None or bw <= 0:
+        return None
+    return flops / bw
+
+
+def verdict(intensity):
+    """'compute-bound' | 'memory-bound' for a program's arithmetic
+    intensity, or None when the ridge point is unknown."""
+    r = ridge()
+    if r is None or intensity is None:
+        return None
+    return "compute-bound" if float(intensity) >= r else "memory-bound"

@@ -339,6 +339,26 @@ def snapshot(reason, exc=None, extra=None):
             bundle["hbm"] = hbm
     except Exception:   # diagnostics must never add a second failure
         pass
+    try:
+        from . import sanitize as _san
+        from . import cost as _cost
+        ledger = _san.cost_ledger()
+        compile_s = _san.compile_seconds()
+        if ledger or compile_s:
+            # per-program cost attribution (cost_report): each compiled
+            # program's FLOPs / bytes / arithmetic intensity, the
+            # resolved roofline peaks (so the bundle's verdicts are
+            # reproducible offline), and per-cache cumulative compile
+            # seconds — the denominator behind every MFU gauge
+            peak_flops, peak_bw = _cost.resolve_peaks()
+            bundle["cost"] = {
+                "programs": ledger,
+                "peaks": {"flops_per_sec": peak_flops,
+                          "bytes_per_sec": peak_bw},
+                "compile_seconds": compile_s,
+            }
+    except Exception:   # diagnostics must never add a second failure
+        pass
     if exc is not None:
         bundle["exception"] = {
             "type": type(exc).__name__,

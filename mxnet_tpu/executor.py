@@ -1023,11 +1023,11 @@ class Executor(object):
                         jax.checkpoint_policies.dots_with_no_batch_dims_saveable
                 f = jax.checkpoint(f, policy=policy)
             fn = jax.jit(f)
-        if _san._hbm_on:
-            # per-program HBM attribution (sentinel): the first call's
-            # concrete arguments drive one lower+compile whose executable
-            # the dispatch reuses; grad kinds first fire under jax.vjp
-            # with tracers, where hbm_capture degrades to a silent skip
+        if _san._hbm_on or _san._cost_on:
+            # per-program HBM/cost attribution: the first call's concrete
+            # arguments drive one lower+compile whose executable the
+            # dispatch reuses; grad kinds first fire under jax.vjp with
+            # tracers, where program_capture degrades to a silent skip
             fn = self._hbm_first_call(fn, kind)
         if _tel._enabled:
             # jax.jit is lazy: the miss's trace+compile cost lands on the
@@ -1058,24 +1058,35 @@ class Executor(object):
             wall = _time.time()
             t0 = _time.perf_counter()
             out = fn(*args)
-            _tel.record_span("xla_compile", wall,
-                             _time.perf_counter() - t0, cat="compile",
+            dur = _time.perf_counter() - t0
+            _tel.record_span("xla_compile", wall, dur, cat="compile",
                              kind=kind)
+            # the first invocation's wall time IS this program's compile
+            # (steady-state dispatch is microseconds) — fold it into the
+            # executor cache's cumulative compile_seconds counter
+            self._san_cache.compile_note(dur)
             self._jit_cache[cache_key] = fn
             return out
         return first_call
 
     def _hbm_first_call(self, fn, kind):
         """Wrap a fresh jit so its first invocation records the compiled
-        program's memory analysis into mxsan's HBM ledger (best-effort:
-        tracer arguments or lowering errors degrade to a skip), then
-        step out of the way."""
+        program's memory analysis and/or cost analysis into mxsan's
+        ledgers (best-effort: tracer arguments or lowering errors degrade
+        to a skip), then step out of the way."""
+        from . import telemetry as _tel
         state = {"done": False}
 
         def hbm_first_call(*args):
             if not state["done"]:
                 state["done"] = True
-                _san.hbm_capture("executor.%s" % kind, fn, args)
+                # compile-seconds: with telemetry on, _timed_first_call
+                # wraps THIS wrapper and its first-call timing already
+                # covers the capture's compile — crediting the cache here
+                # too would double-count
+                _san.program_capture(
+                    "executor.%s" % kind, fn, args,
+                    cache=None if _tel._enabled else self._san_cache)
             return fn(*args)
         return hbm_first_call
 

@@ -713,6 +713,14 @@ class _FusedFit(object):
         step-interval checkpoint cadence)."""
         return self._ts.num_update
 
+    def step_flops(self):
+        """Model FLOPs of one fused step from the TrainStep's captured
+        cost row (the fit loop's MFU numerator), or None while cost
+        attribution is off, before the first dispatch, or on step types
+        that don't capture (pipeline)."""
+        fn = getattr(self._ts, "step_flops", None)
+        return fn() if fn is not None else None
+
     def save_checkpoint(self, checkpointer, epoch=0, nbatch=0, extra=None):
         """Snapshot the LIVE fused training state through the sharded
         (async) checkpoint writer — params/optimizer state/aux plus the

@@ -351,12 +351,12 @@ def jitted(op, attrs, is_train=False):
     fn = _JIT_CACHE.get(key)
     if fn is None:
         fn = jax.jit(op.make_callable(attrs, is_train))
-        if _san._hbm_on:
-            # per-program HBM attribution (sentinel): first call captures
-            # memory_analysis() from the arguments it compiles for; the
-            # cached entry keeps the wrapper, whose steady-state cost is
-            # one flag read
-            fn = _san.hbm_wrap("op.%s" % op.name, fn)
+        if _san._hbm_on or _san._cost_on:
+            # per-program HBM/cost attribution: first call captures
+            # memory_analysis()/cost_analysis() from the arguments it
+            # compiles for; the cached entry keeps the wrapper, whose
+            # steady-state cost is one flag read
+            fn = _san.program_wrap("op.%s" % op.name, fn, cache=_SAN_CACHE)
         _JIT_CACHE[key] = fn
         _SAN_CACHE.miss({"op": op.name, "attrs": attr_key(attrs),
                          "is_train": bool(is_train), "seq_mesh": seq_key})

@@ -92,6 +92,7 @@ See docs/static_analysis.md "Runtime sanitizers".
 from __future__ import annotations
 
 import threading
+import time
 import warnings
 import weakref
 from collections import deque
@@ -108,7 +109,9 @@ __all__ = ["SanitizerError", "SanitizerWarning", "arm", "disarm", "armed",
            "allow_thread_collective", "ledger_tail", "collective_state",
            "expect_recompile", "sig_nbytes", "record_wire_bytes",
            "wire_bytes", "hbm_arm", "hbm_disarm", "hbm_ledger",
-           "hbm_note", "hbm_capture", "hbm_wrap"]
+           "hbm_note", "hbm_capture", "hbm_wrap", "cost_arm",
+           "cost_disarm", "cost_ledger", "cost_note", "program_capture",
+           "program_wrap", "compile_seconds"]
 
 CHECKERS = ("recompile", "sync", "donate", "collective")
 
@@ -167,6 +170,8 @@ _wire_bytes = {}          # (kind, axes) -> cumulative payload bytes folded
                           # out of dispatch signatures (record_wire_bytes)
 _hbm_on = False           # per-program HBM attribution armed (sentinel)
 _hbm_ledger = {}          # program name -> memory_analysis byte breakdown
+_cost_on = False          # per-program cost attribution armed
+_cost_ledger = {}         # program name -> cost_analysis flop/byte row
 _tls = threading.local()
 _log_handler = None       # compile-log watcher state
 _log_prev_level = None
@@ -239,6 +244,7 @@ class _CacheHandle(object):
         self._misses = 0
         self._miss_anchor = 0       # miss count when the checker was armed
         self._warned = 0
+        self._compile_s = 0.0       # cumulative XLA compile wall seconds
 
     # -- registry plumbing
     def alive(self):
@@ -304,11 +310,23 @@ class _CacheHandle(object):
             "class)" % ("; ".join(parts) or "<none — duplicate key, "
                         "entries are being evicted/rebuilt>")
 
+    # -- compile-time accounting (call with the wall seconds one XLA
+    #    compile took; cumulative per cache, mirrored to /metrics)
+    def compile_note(self, seconds):
+        seconds = float(seconds)
+        with _lock:
+            self._compile_s += seconds
+            total = self._compile_s
+        if _tel._enabled:
+            _tel.counter("compile_ms", int(seconds * 1e3), cache=self.name)
+            _tel.gauge("compile_seconds", round(total, 3), cache=self.name)
+
     def snapshot(self):
         with _lock:
             return {"name": self.name, "kind": self.kind,
                     "entries": self.entries(), "misses": self._misses,
-                    "warm": len(self._warm), "warmup": self._budget()}
+                    "warm": len(self._warm), "warmup": self._budget(),
+                    "compile_seconds": round(self._compile_s, 6)}
 
 
 def register_cache(name, kind=None, owner=None, sizer=None, warmup=None,
@@ -819,14 +837,8 @@ def hbm_capture(name, fn, args=(), kwargs=None):
     measures."""
     if not _hbm_on:
         return None
-    try:
-        compiled = fn.lower(*args, **(kwargs or {})).compile()
-        stats = compiled.memory_analysis()
-        if stats is None:
-            return None
-        return hbm_note(name, stats)
-    except Exception:
-        return None
+    out = program_capture(name, fn, args, kwargs)
+    return out.get("hbm") if out else None
 
 
 def hbm_wrap(name, fn):
@@ -837,17 +849,156 @@ def hbm_wrap(name, fn):
     the first call."""
     if not _hbm_on:
         return fn
+    return program_wrap(name, fn)
+
+
+# ------------------------------------------- per-program cost attribution
+# The HBM ledger's compute twin: the same capture-at-compile hook also
+# records the compiled program's ``cost_analysis()`` — model FLOPs,
+# bytes accessed, transcendentals — so every jit program has a cost
+# identity (roofline arithmetic intensity) and the fused fit can fold
+# measured step wall time into an MFU against MXNET_PEAK_FLOPS.  Armed
+# with HBM attribution by the sentinel, or alone by the fused fit when
+# peaks are configured; with ``_cost_on`` False every entry point is one
+# bool read.  Rendered by tools/cost_report.py; surfaced as the ``cost``
+# diagnostics-bundle section and the ``cost_program_flops`` gauges.
+
+def cost_arm():
+    """Arm per-program cost attribution (capture-at-compile)."""
+    global _cost_on
+    with _lock:
+        _cost_on = True
+
+
+def cost_disarm():
+    """Disarm cost attribution and clear the ledger."""
+    global _cost_on
+    with _lock:
+        _cost_on = False
+        _cost_ledger.clear()
+
+
+def cost_ledger():
+    """Snapshot of the per-program cost ledger: ``{name: {flops,
+    bytes_accessed, transcendentals, intensity, compile_seconds}}``.
+    ``intensity`` is flops / bytes_accessed (the roofline x-axis); a
+    program whose backend reports no byte traffic carries 0.0."""
+    with _lock:
+        return {k: dict(v) for k, v in sorted(_cost_ledger.items())}
+
+
+def _cost_props(analysis):
+    """Normalize a ``cost_analysis()`` result to one flat dict.  jax has
+    returned both a list of per-device dicts and a bare dict across
+    versions; every device runs the same SPMD program, so the first
+    entry speaks for all."""
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict):
+        return None
+    return analysis
+
+
+def cost_note(name, analysis, compile_s=None):
+    """Fold one compiled program's ``cost_analysis()`` into the ledger
+    under ``name`` (last capture wins, mirroring the jit cache it
+    describes).  Returns the row, or None when the backend reported
+    nothing usable."""
+    props = _cost_props(analysis)
+    if props is None:
+        return None
+    row = {
+        "flops": int(props.get("flops", 0) or 0),
+        "bytes_accessed": int(props.get("bytes accessed", 0) or 0),
+        "transcendentals": int(props.get("transcendentals", 0) or 0),
+    }
+    row["intensity"] = (round(row["flops"] / float(row["bytes_accessed"]), 4)
+                        if row["bytes_accessed"] else 0.0)
+    if compile_s is not None:
+        row["compile_seconds"] = round(float(compile_s), 6)
+    with _lock:
+        _cost_ledger[str(name)] = row
+    if _tel._enabled:
+        _tel.gauge("cost_program_flops", row["flops"], program=str(name))
+    return row
+
+
+def program_capture(name, fn, args=(), kwargs=None, cache=None):
+    """The unified capture-at-compile hook: one timed
+    ``fn.lower(*args).compile()`` (the executable is shared with the jit
+    cache, so arming pays each compile once), then whatever ledgers are
+    armed — ``memory_analysis()`` when ``_hbm_on``, ``cost_analysis()``
+    when ``_cost_on`` — plus compile-seconds accounting against
+    ``cache`` (a register_cache handle) and a ``compile.seconds``
+    telemetry span.  Best-effort like :func:`hbm_capture`: any failure
+    degrades to a silent None.  Returns ``{"hbm": row|None,
+    "cost": row|None}``."""
+    if not (_hbm_on or _cost_on):
+        return None
+    wall = time.time()
+    t0 = time.perf_counter()
+    try:
+        compiled = fn.lower(*args, **(kwargs or {})).compile()
+    except Exception:
+        return None
+    dur = time.perf_counter() - t0
+    if cache is not None:
+        try:
+            cache.compile_note(dur)
+        except Exception:
+            pass
+    if _tel._enabled:
+        _tel.record_span("compile.seconds", wall, dur, cat="compile",
+                         program=str(name))
+    out = {"hbm": None, "cost": None}
+    if _hbm_on:
+        try:
+            stats = compiled.memory_analysis()
+            if stats is not None:
+                out["hbm"] = hbm_note(name, stats)
+        except Exception:
+            pass
+    if _cost_on:
+        try:
+            out["cost"] = cost_note(name, compiled.cost_analysis(),
+                                    compile_s=dur)
+        except Exception:
+            pass
+    return out
+
+
+def program_wrap(name, fn, cache=None):
+    """Wrap a jitted callable so its first invocation runs
+    :func:`program_capture` on the very arguments it compiles for.
+    Returns ``fn`` unchanged while both ledgers are off (the
+    strict-no-op contract); the armed wrapper self-removes its overhead
+    down to one bool read after the first call."""
+    if not (_hbm_on or _cost_on):
+        return fn
     state = {"done": False}
 
     def first_call(*args, **kwargs):
         if not state["done"]:
             state["done"] = True
-            hbm_capture(name, fn, args, kwargs)
+            program_capture(name, fn, args, kwargs, cache=cache)
         return fn(*args, **kwargs)
 
     first_call.__name__ = getattr(fn, "__name__", "first_call")
     first_call.__wrapped__ = fn
     return first_call
+
+
+def compile_seconds():
+    """Cumulative XLA compile wall seconds per registered cache (plus a
+    ``total``), fed by ``_CacheHandle.compile_note`` — the seconds the
+    ROADMAP persistent-compilation-cache item would save.  Caches that
+    never compiled are omitted; empty dict when nothing was measured."""
+    with _lock:
+        out = {h.name: round(h._compile_s, 6)
+               for h in _CACHES if h._compile_s > 0.0}
+        if out:
+            out["total"] = round(sum(out.values()), 6)
+        return out
 
 
 def note_collective(kind, name=None, sig=None, axes=None, device=True):
@@ -1520,6 +1671,7 @@ def reset():
         _violations.clear()
         _wire_bytes.clear()
         _hbm_ledger.clear()
+        _cost_ledger.clear()
         _DONATED.clear()
         _RAW_COMPILES.clear()
         _coll_ledger.clear()
@@ -1534,6 +1686,7 @@ def reset():
         for h in _CACHES:
             h._miss_anchor = h._misses
             h._warned = 0
+            h._compile_s = 0.0
 
 
 # ------------------------------------------------- autostart (env contract)

@@ -10,7 +10,11 @@ straggler rank — while training is still in progress.
 Arm with ``MXNET_SENTINEL=step:<k>sigma[:raise]`` (e.g. ``step:3sigma``;
 ``:raise`` fails the run instead of warning).  A ``hbm`` token (alone or
 ``step:3sigma,hbm``) arms per-program HBM attribution
-(``sanitize.hbm_ledger``); any armed spec arms it implicitly.  With the
+(``sanitize.hbm_ledger``); any armed spec arms it implicitly, along with
+the cost ledger (``sanitize.cost_ledger``) — and when roofline peaks are
+configured (``MXNET_PEAK_FLOPS``), the fit feeds per-step MFU in as an
+extra watched series (inverted z: utilization *dropping* is the
+regression).  With the
 variable unset this module is a strict no-op: no thread, no file, no
 state accrual — every entry point degrades to one module-global bool
 check (the telemetry/sanitize autostart discipline, pinned in
@@ -189,6 +193,7 @@ def arm(spec="step:3sigma", mode=None):
         _on = True
     from . import sanitize as _san
     _san.hbm_arm()
+    _san.cost_arm()
     if not _tel._enabled:
         _tel._fr_arm(_FR_CAP)
         _armed_fr = True
@@ -213,6 +218,7 @@ def disarm():
     if was_on:
         from . import sanitize as _san
         _san.hbm_disarm()
+        _san.cost_disarm()
     if was_fr:
         _tel._fr_disarm()
     reset()
@@ -260,11 +266,15 @@ def _wire_total():
         return 0
 
 
-def step_close(total_s, data_wait_s, compute_s, epoch=None, nbatch=None):
+def step_close(total_s, data_wait_s, compute_s, epoch=None, nbatch=None,
+               mfu=None):
     """Fold one completed fit step into the rolling baseline and run the
     anomaly check.  Called by ``Module.fit`` at step close, next to the
     ``step`` span — call sites guard with ``if sentinel._on:`` so the
-    disarmed loop body is byte-for-byte the original."""
+    disarmed loop body is byte-for-byte the original.  ``mfu`` (the
+    step's model-FLOP utilization, when peaks are configured) joins the
+    watched series with an INVERTED z-score — efficiency falling is the
+    regression — and is simply absent from the baseline when None."""
     if not _on or not _detect:
         return
     global _steps, _consec, _suppress, _last, _last_wire, _anomalies, \
@@ -282,6 +292,9 @@ def step_close(total_s, data_wait_s, compute_s, epoch=None, nbatch=None):
                "stall": max(0.0, float(total_s) - float(data_wait_s)
                             - float(compute_s)),
                "epoch": epoch, "nbatch": nbatch}
+        if mfu is not None:
+            row["mfu"] = float(mfu)
+        series = _SERIES + (("mfu",) if "mfu" in row else ())
         _last = row
         # z-scores against the baseline BEFORE this sample folds in (a
         # rolling baseline that ate the anomalous step first would chase
@@ -291,12 +304,17 @@ def step_close(total_s, data_wait_s, compute_s, epoch=None, nbatch=None):
             _suppress -= 1
         elif _steps >= _warmup:
             zscores = {}
-            for s in _SERIES:
+            for s in series:
+                if s not in _ewma:      # mfu arrived after warmup closed
+                    continue
                 mean, var = _ewma[s]
                 sigma = max(math.sqrt(max(var, 0.0)),
                             _SIGMA_REL_FLOOR * abs(mean),
                             _SIGMA_ABS_FLOOR)
-                zscores[s] = (row[s] - mean) / sigma
+                z = (row[s] - mean) / sigma
+                # mfu is a HIGHER-is-better series: invert so a drop in
+                # utilization scores positive like a rise in step time
+                zscores[s] = -z if s == "mfu" else z
         # an over-threshold sample is QUARANTINED from the fold: letting
         # it in would inflate the EWM variance step by step and a
         # sustained slowdown could dodge the K-consecutive trigger by
@@ -310,7 +328,7 @@ def step_close(total_s, data_wait_s, compute_s, epoch=None, nbatch=None):
                 # every step, so the first step's compile time (often
                 # 100x the steady step) is an ignored outlier instead of
                 # a mean the whole run drags behind
-                for s in _SERIES:
+                for s in series:
                     buf = _warm_buf.setdefault(s, [])
                     buf.append(row[s])
                     med = _median(buf)
@@ -319,7 +337,7 @@ def step_close(total_s, data_wait_s, compute_s, epoch=None, nbatch=None):
                 if _steps + 1 >= _warmup:
                     _warm_buf.clear()
             else:
-                for s in _SERIES:
+                for s in series:
                     st = _ewma.get(s)
                     if st is None:
                         _ewma[s] = [row[s], 0.0]
@@ -333,7 +351,8 @@ def step_close(total_s, data_wait_s, compute_s, epoch=None, nbatch=None):
         elif zscores["step"] > _k_sigma:
             _consec += 1
             if _consec >= _consec_k:
-                dom = max(PHASES, key=lambda p: zscores[p])
+                watched = PHASES + (("mfu",) if "mfu" in zscores else ())
+                dom = max(watched, key=lambda p: zscores[p])
                 _anomalies += 1
                 anomaly = _last_anomaly = {
                     "phase": dom, "k_sigma": _k_sigma,
@@ -342,7 +361,7 @@ def step_close(total_s, data_wait_s, compute_s, epoch=None, nbatch=None):
                     "baseline": {s: {"mean": _ewma[s][0],
                                      "sigma": math.sqrt(max(_ewma[s][1],
                                                             0.0))}
-                                 for s in _SERIES},
+                                 for s in zscores},
                     "steps": _steps,
                     "suppressed_marker": _last_marker,
                 }
@@ -396,7 +415,7 @@ def anatomy():
             return None
         out = {s: {"mean": _ewma[s][0],
                    "sigma": math.sqrt(max(_ewma[s][1], 0.0))}
-               for s in _SERIES if s in _ewma}
+               for s in _SERIES + ("mfu",) if s in _ewma}
         return {"steps": _steps, "series": out,
                 "anomalies": _anomalies, "suppress": _suppress}
 
@@ -422,7 +441,7 @@ def digest():
         if not _on or not _detect or not _steps:
             return None
         d = {"steps": _steps}
-        for s in _SERIES:
+        for s in _SERIES + ("mfu",):
             if s in _ewma:
                 d[s] = round(_ewma[s][0], 9)
         return d

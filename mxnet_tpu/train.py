@@ -597,7 +597,8 @@ class TrainStep(object):
         self._step_fn = step_amp if self._has_scale else step
         self._donate = (0, 1, 2, 3) if self._has_scale else (0, 1, 2)
         self._multi_cache = {}
-        self._hbm_done = False   # step program's HBM capture (once)
+        self._hbm_done = False   # step program's HBM/cost capture (once)
+        self._cost_row = None    # step program's cost ledger row (MFU)
         # mxsan: run_steps' chunk programs are a jit cache too (keyed on
         # (num_steps, stacked, trace-env snapshot) below)
         self._san_cache = _san.register_cache(
@@ -693,10 +694,11 @@ class TrainStep(object):
             gather.__name__ = "mxtpu_zero_gather"
             self._gather_fn = jax.jit(gather, out_shardings=rep)
             self._san_gather.miss({"params": len(self.param_names)})
-            if _san._hbm_on:
-                # HBM attribution for the gather program (compile reuse:
-                # the first call below hits the cached executable)
-                _san.hbm_capture("zero.gather", self._gather_fn, (params,))
+            if _san._hbm_on or _san._cost_on:
+                # HBM/cost attribution for the gather program (compile
+                # reuse: the first call below hits the cached executable)
+                _san.program_capture("zero.gather", self._gather_fn,
+                                     (params,), cache=self._san_gather)
         if _san._collective_on:
             # ledger entry at dispatch, from shape metadata (no sync)
             _san.note_collective(
@@ -1085,19 +1087,20 @@ class TrainStep(object):
             self._san_cache.miss({"num_steps": num_steps,
                                   "stacked": stacked,
                                   "trace_env": cache_key[2]})
-            if _san._hbm_on:
-                # HBM attribution for the fresh chunk program, captured
-                # BEFORE the first call (the arguments are still alive —
-                # the call below donates them) from the very values it
-                # will compile for; lower().compile() here is the
-                # compile, the dispatch below reuses the executable
+            if _san._hbm_on or _san._cost_on:
+                # HBM/cost attribution for the fresh chunk program,
+                # captured BEFORE the first call (the arguments are still
+                # alive — the call below donates them) from the very
+                # values it will compile for; lower().compile() here is
+                # the compile, the dispatch below reuses the executable
                 cargs = (params, opt_state, aux)
                 if self._has_scale:
                     cargs = cargs + (self._scale_state_dev(),)
-                _san.hbm_capture(
+                _san.program_capture(
                     "train_step.run_steps[n=%d%s]"
                     % (num_steps, ",stacked" if stacked else ""),
-                    fn, cargs + (batch, rng, hyper, _np.int32(t0)))
+                    fn, cargs + (batch, rng, hyper, _np.int32(t0)),
+                    cache=self._san_cache)
         args = (params, opt_state, aux)
         if self._has_scale:
             args = args + (self._scale_state_dev(),)
@@ -1113,6 +1116,13 @@ class TrainStep(object):
             return res[0], res[1], res[2], res[4]
         return res
 
+    def step_flops(self):
+        """Model FLOPs of one fused step, from the cost row captured at
+        the step program's compile — the MFU numerator.  None before the
+        first dispatch or while cost attribution is disarmed."""
+        row = self._cost_row
+        return row.get("flops") if row else None
+
     # ------------------------------------------------------------------- call
     def __call__(self, params, opt_state, aux, batch, rng=None):
         """One fused step.  Returns (params, opt_state, aux, outputs)."""
@@ -1126,16 +1136,19 @@ class TrainStep(object):
         args = (params, opt_state, aux)
         if self._has_scale:
             args = args + (self._scale_state_dev(),)
-        if _san._hbm_on and not self._hbm_done:
-            # HBM attribution for the step program — once per instance,
-            # BEFORE the first (donating) dispatch so the captured
-            # arguments are still alive.  The jitted callable itself is
-            # NOT wrapped: __graft_entry__ AOT-lowers self._step directly
+        if (_san._hbm_on or _san._cost_on) and not self._hbm_done:
+            # HBM/cost attribution for the step program — once per
+            # instance, BEFORE the first (donating) dispatch so the
+            # captured arguments are still alive.  The jitted callable
+            # itself is NOT wrapped: __graft_entry__ AOT-lowers
+            # self._step directly
             self._hbm_done = True
-            _san.hbm_capture("train_step[%s]" % self._step_fn.__name__,
-                             self._step,
-                             args + (batch, rng, hyper,
-                                     _np.int32(self.num_update)))
+            cap = _san.program_capture(
+                "train_step[%s]" % self._step_fn.__name__, self._step,
+                args + (batch, rng, hyper, _np.int32(self.num_update)),
+                cache=self._san_cache)
+            if cap and cap.get("cost"):
+                self._cost_row = cap["cost"]
         if _san._donate_on:
             # a buffer donated by an earlier step re-entering here is the
             # delete-on-donate bug — name it before XLA crashes cryptically

@@ -109,6 +109,15 @@ assert _sen._steps == 0, "sentinel folded a step"
 assert _sen.anatomy() is None and _sen.last_anomaly() is None
 assert _san._hbm_on is False, "HBM attribution armed"
 assert _san.hbm_ledger() == {}, "HBM ledger grew while disarmed"
+
+# cost attribution: with neither MXNET_SENTINEL nor the roofline peak
+# vars set there is no cost ledger, no compile-seconds accounting, and
+# no resolved peak pair (MFU gauges never fire)
+assert _san._cost_on is False, "cost attribution armed"
+assert _san.cost_ledger() == {}, "cost ledger grew while disarmed"
+assert _san.compile_seconds() == {}, "compile seconds accrued at import"
+import mxnet_tpu.cost as _cost
+assert _cost._cache is None, "roofline peaks resolved at import"
 assert _dist._sent_seq == 0, "sentinel digest exchange advanced"
 assert _dist.straggler() is None, "straggler verdict exists"
 

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Render a per-program cost-attribution ledger: roofline table, FLOPs /
+bytes / arithmetic intensity per program, and compile-time totals.
+
+The cost ledger is the HBM ledger's compute twin (PR 18 -> this):
+``MXNET_SENTINEL`` — or a fit with ``MXNET_PEAK_FLOPS`` configured —
+arms capture-at-compile cost attribution, recording every jit program's
+``cost_analysis()`` (model FLOPs, bytes accessed, transcendentals) into
+``sanitize.cost_ledger()``.  The ledger rides diagnostics bundles as the
+``cost`` section (with the resolved roofline peaks and per-cache
+cumulative compile seconds) and ``/metrics`` as the
+``cost_program_flops`` gauges.  This tool renders it for humans and CI:
+
+    python tools/cost_report.py mxtpu_diag.perf_anomaly.pid1234.json
+    python tools/cost_report.py cost_ledger.json --json
+    python tools/cost_report.py bundle.json --top 5
+
+Accepts a diagnostics bundle (reads its ``cost`` section), a bare cost
+section ``{programs, peaks, compile_seconds}``, or a bare ledger
+document ``{program: {flops, bytes_accessed, ...}}``.  Rows sort by
+FLOPs, descending.  When both peaks are known each program gets a
+roofline verdict: compute-bound when its intensity (FLOP/byte) is at or
+above the machine ridge point (peak FLOP/s over peak bytes/s), else
+memory-bound.  ``--peak-flops`` / ``--peak-bw`` override the bundle's
+recorded peaks (SI suffixes accepted: ``275T``, ``1228G``).  Pure
+stdlib.  Table layout shared with hbm_report via ledger_table.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+FIELDS = ("flops", "bytes_accessed", "transcendentals")
+_SUFFIX = {"k": 1e3, "m": 1e6, "g": 1e9, "t": 1e12, "p": 1e15}
+
+
+def _sibling(name):
+    """Load a sibling tool as a library (tools/ is not a package) — the
+    telemetry_report idiom."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "%s.py" % name)
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def parse_rate(raw):
+    """``'275e12'`` / ``'275T'`` -> float, None on junk/unset (the
+    mxnet_tpu.cost grammar, standalone so the tool stays stdlib-pure)."""
+    if raw is None:
+        return None
+    raw = str(raw).strip()
+    if not raw:
+        return None
+    mult = 1.0
+    if raw[-1].lower() in _SUFFIX:
+        mult = _SUFFIX[raw[-1].lower()]
+        raw = raw[:-1]
+    try:
+        val = float(raw) * mult
+    except ValueError:
+        return None
+    return val if val > 0 else None
+
+
+def load_cost(path):
+    """``{"programs", "peaks", "compile_seconds"}`` from a diagnostics
+    bundle's ``cost`` section, a bare section, or a bare ledger.  Raises
+    ValueError when the file is none of those."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError("%s: not a JSON object" % path)
+    if doc.get("type") == "mxtpu_diagnostics":
+        cost = doc.get("cost")
+        if not cost or not isinstance(cost, dict):
+            raise ValueError(
+                "%s: diagnostics bundle has no 'cost' section — was "
+                "MXNET_SENTINEL (or a fit with MXNET_PEAK_FLOPS) armed "
+                "when it was written?" % path)
+        doc = cost
+    if isinstance(doc.get("programs"), dict):
+        return {"programs": doc["programs"],
+                "peaks": doc.get("peaks") or {},
+                "compile_seconds": doc.get("compile_seconds") or {}}
+    if doc and all(isinstance(v, dict) and "flops" in v
+                   for v in doc.values()):
+        return {"programs": doc, "peaks": {}, "compile_seconds": {}}
+    raise ValueError("%s: neither a diagnostics bundle nor a cost "
+                     "ledger document" % path)
+
+
+def summarize(cost, peak_flops=None, peak_bw=None):
+    """Sorted rows + totals + roofline context.  Explicit peaks override
+    the recorded ones; with both known, every row gets a ``verdict`` and
+    the summary carries the ``ridge`` point (FLOP/byte)."""
+    peaks = cost.get("peaks") or {}
+    pf = peak_flops if peak_flops is not None else peaks.get("flops_per_sec")
+    pb = peak_bw if peak_bw is not None else peaks.get("bytes_per_sec")
+    ridge = (pf / pb) if pf and pb else None
+    rows = []
+    for name, r in sorted(cost["programs"].items(),
+                          key=lambda kv: -kv[1].get("flops", 0)):
+        row = dict(r)
+        if "intensity" not in row:
+            row["intensity"] = (round(row.get("flops", 0)
+                                      / float(row["bytes_accessed"]), 4)
+                                if row.get("bytes_accessed") else 0.0)
+        if ridge is not None:
+            row["verdict"] = "compute" \
+                if row["intensity"] >= ridge else "memory"
+        rows.append((name, row))
+    totals = {f: sum(int(r.get(f, 0) or 0) for _, r in rows)
+              for f in FIELDS}
+    totals["intensity"] = (round(totals["flops"]
+                                 / float(totals["bytes_accessed"]), 4)
+                           if totals["bytes_accessed"] else 0.0)
+    return {"programs": rows, "totals": totals, "ridge": ridge,
+            "peaks": {"flops_per_sec": pf, "bytes_per_sec": pb},
+            "compile_seconds": dict(cost.get("compile_seconds") or {})}
+
+
+def render(summary, out=None, top=None):
+    out = sys.stdout if out is None else out
+    lt = _sibling("ledger_table")
+    rows = summary["programs"]
+    ridge = summary["ridge"]
+    title = "Per-program cost attribution (%d program(s))" % len(rows)
+    if ridge is not None:
+        title += " — ridge %.1f flop/byte" % ridge
+    columns = [("gflops", lt.scaled("flops", 1e9)),
+               ("mb_acc", lt.mb("bytes_accessed")),
+               ("transc_m", lt.scaled("transcendentals", 1e6)),
+               ("f/byte", lt.scaled("intensity", 1.0)),
+               ("bound", lambda r: r.get("verdict", "-"))]
+    lt.render_ledger(rows, columns, out=out, top=top,
+                     totals=summary["totals"], title=title)
+    comp = summary["compile_seconds"]
+    if comp:
+        out.write("Compile seconds by jit cache:\n")
+        for cache in sorted(k for k in comp if k != "total"):
+            out.write("  %-34s %10.3f\n" % (cache, comp[cache]))
+        if "total" in comp:
+            out.write("  %-34s %10.3f\n" % ("TOTAL", comp["total"]))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="diagnostics bundle or cost ledger (JSON)")
+    ap.add_argument("--top", type=int, default=None,
+                    help="show only the N most FLOP-heavy programs")
+    ap.add_argument("--peak-flops", default=None,
+                    help="peak FLOP/s override (e.g. 275T)")
+    ap.add_argument("--peak-bw", default=None,
+                    help="peak memory bytes/s override (e.g. 1228G)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON document")
+    args = ap.parse_args(argv)
+    try:
+        cost = load_cost(args.path)
+    except (OSError, ValueError) as e:
+        sys.stderr.write("cost_report: %s\n" % e)
+        return 1
+    summary = summarize(cost, peak_flops=parse_rate(args.peak_flops),
+                        peak_bw=parse_rate(args.peak_bw))
+    if args.json:
+        json.dump({"programs": [{"name": n, **r}
+                                for n, r in summary["programs"]],
+                   "totals": summary["totals"],
+                   "ridge": summary["ridge"],
+                   "peaks": summary["peaks"],
+                   "compile_seconds": summary["compile_seconds"]},
+                  sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        return 0
+    render(summary, top=args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

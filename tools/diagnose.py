@@ -57,7 +57,10 @@ def _fmt_ts(ts):
         return str(ts)
 
 
-def render(bundle, out=sys.stdout, events=10, stacks=True):
+def render(bundle, out=None, events=10, stacks=True):
+    # call-time stdout: a def-time default freezes the stream installed
+    # at first import (pytest capture, redirection) — the PR-12 bug class
+    out = sys.stdout if out is None else out
     reason = bundle.get("reason", "?")
     out.write("== mxnet_tpu diagnostics bundle: %s ==\n" % reason)
     out.write("time   %s\n" % _fmt_ts(bundle.get("time")))
@@ -153,6 +156,11 @@ def render(bundle, out=sys.stdout, events=10, stacks=True):
                     out.write("    %-12s %10.3f mb   +/- %.3f\n"
                               % (name, st.get("mean", 0.0),
                                  st.get("sigma", 0.0)))
+                elif name == "mfu":
+                    # model-FLOP utilization: a ratio, not a duration
+                    out.write("    %-12s %10.4f      +/- %.4f\n"
+                              % (name, st.get("mean", 0.0),
+                                 st.get("sigma", 0.0)))
                 else:
                     out.write("    %-12s %10.2f ms   +/- %.2f\n"
                               % (name, st.get("mean", 0.0) * 1e3,
@@ -190,6 +198,35 @@ def render(bundle, out=sys.stdout, events=10, stacks=True):
         out.write("  %-32s %10.2f MB\n"
                   % ("TOTAL", sum(r.get("total", 0)
                                   for r in hbm.values()) / 1e6))
+
+    cost = bundle.get("cost")
+    if cost:
+        peaks = cost.get("peaks") or {}
+        pf, pb = peaks.get("flops_per_sec"), peaks.get("bytes_per_sec")
+        ridge = (pf / pb) if pf and pb else None
+        out.write("\nCost attribution (per compiled program)%s\n"
+                  % ("  [ridge %.1f flop/byte]" % ridge
+                     if ridge is not None else ""))
+        programs = cost.get("programs") or {}
+        rows = sorted(programs.items(),
+                      key=lambda kv: -kv[1].get("flops", 0))
+        for name, row in rows:
+            intensity = row.get("intensity", 0.0)
+            bound = ""
+            if ridge is not None:
+                bound = "  %s-bound" % ("compute" if intensity >= ridge
+                                        else "memory")
+            out.write("  %-32s %10.2f GFLOP  (%.2f MB accessed, "
+                      "%.2f flop/byte%s)\n"
+                      % (name, row.get("flops", 0) / 1e9,
+                         row.get("bytes_accessed", 0) / 1e6,
+                         intensity, bound))
+        comp = cost.get("compile_seconds") or {}
+        for cache in sorted(k for k in comp if k != "total"):
+            out.write("  compile %-24s %10.3f s\n" % (cache, comp[cache]))
+        if "total" in comp:
+            out.write("  compile %-24s %10.3f s\n"
+                      % ("TOTAL", comp["total"]))
 
     fr = bundle.get("flight_recorder")
     if fr:

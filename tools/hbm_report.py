@@ -23,9 +23,23 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 FIELDS = ("args", "outputs", "temps", "generated_code", "alias", "total")
+
+
+def _sibling(name):
+    """Load a sibling tool as a library (tools/ is not a package) — the
+    telemetry_report idiom; the ledger table is shared with
+    cost_report through ledger_table.py instead of growing a second."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "%s.py" % name)
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def load_ledger(path):
@@ -60,27 +74,15 @@ def summarize(ledger):
 
 def render(summary, out=None, top=None):
     out = sys.stdout if out is None else out
+    lt = _sibling("ledger_table")
     rows = summary["programs"]
-    shown = rows[:top] if top else rows
-    out.write("Per-program HBM attribution (%d program(s))\n" % len(rows))
-    out.write("%-36s %10s %10s %10s %10s %10s %10s\n"
-              % ("program", "total_mb", "args_mb", "out_mb", "temps_mb",
-                 "code_mb", "alias_mb"))
-    for name, r in shown:
-        out.write("%-36s %10.2f %10.2f %10.2f %10.2f %10.2f %10.2f\n"
-                  % (name, r.get("total", 0) / 1e6,
-                     r.get("args", 0) / 1e6, r.get("outputs", 0) / 1e6,
-                     r.get("temps", 0) / 1e6,
-                     r.get("generated_code", 0) / 1e6,
-                     r.get("alias", 0) / 1e6))
-    if top and len(rows) > top:
-        out.write("  ... %d more program(s) (--top %d)\n"
-                  % (len(rows) - top, top))
-    t = summary["totals"]
-    out.write("%-36s %10.2f %10.2f %10.2f %10.2f %10.2f %10.2f\n"
-              % ("TOTAL", t["total"] / 1e6, t["args"] / 1e6,
-                 t["outputs"] / 1e6, t["temps"] / 1e6,
-                 t["generated_code"] / 1e6, t["alias"] / 1e6))
+    columns = [("total_mb", lt.mb("total")), ("args_mb", lt.mb("args")),
+               ("out_mb", lt.mb("outputs")), ("temps_mb", lt.mb("temps")),
+               ("code_mb", lt.mb("generated_code")),
+               ("alias_mb", lt.mb("alias"))]
+    lt.render_ledger(
+        rows, columns, out=out, top=top, totals=summary["totals"],
+        title="Per-program HBM attribution (%d program(s))" % len(rows))
 
 
 def main(argv=None):
@@ -112,6 +114,5 @@ if __name__ == "__main__":
     try:
         sys.exit(main())
     except BrokenPipeError:
-        import os
         os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
         sys.exit(0)

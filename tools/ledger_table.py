@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Shared fixed-width ledger-table renderer for the per-program reports.
+
+``hbm_report`` (byte ledgers) and ``cost_report`` (FLOP/byte ledgers)
+render the same shape: a name column, right-aligned value columns, an
+optional ``--top`` elision line, and a TOTAL footer.  One renderer here
+keeps the two reports' tables from drifting apart.  Loaded via the
+``_sibling`` importlib idiom (tools/ is not a package).  Pure stdlib.
+"""
+from __future__ import annotations
+
+import sys
+
+NAME_W = 36          # program-name column width (matches hbm_report v1)
+COL_W = 10           # value column width
+
+
+def render_ledger(rows, columns, out=None, title=None, top=None,
+                  totals=None, total_label="TOTAL", name_header="program"):
+    """Write one ledger table.
+
+    ``rows`` is ``[(name, row_dict), ...]`` already sorted; ``columns``
+    is ``[(header, fmt), ...]`` where ``fmt(row_dict)`` returns the
+    cell's string (right-aligned into a %10s slot — ``"%.2f"`` floats
+    reproduce the classic ``%10.2f`` layout exactly).  ``top`` elides
+    all but the first N rows with a count line; ``totals`` (a row dict)
+    adds a footer rendered through the same formatters."""
+    out = sys.stdout if out is None else out
+    if title:
+        out.write(title + "\n")
+    out.write("%-*s" % (NAME_W, name_header)
+              + "".join(" %*s" % (COL_W, h) for h, _ in columns) + "\n")
+    shown = rows[:top] if top else rows
+    for name, r in shown:
+        out.write("%-*s" % (NAME_W, name)
+                  + "".join(" %*s" % (COL_W, fmt(r)) for _, fmt in columns)
+                  + "\n")
+    if top and len(rows) > top:
+        out.write("  ... %d more program(s) (--top %d)\n"
+                  % (len(rows) - top, top))
+    if totals is not None:
+        out.write("%-*s" % (NAME_W, total_label)
+                  + "".join(" %*s" % (COL_W, fmt(totals))
+                            for _, fmt in columns) + "\n")
+
+
+def mb(field):
+    """Column formatter: ``row[field]`` bytes -> MB with 2 decimals."""
+    return lambda r: "%.2f" % (float(r.get(field, 0) or 0) / 1e6)
+
+
+def scaled(field, div=1.0, prec=2):
+    """Column formatter: ``row[field] / div`` with ``prec`` decimals."""
+    return lambda r: "%.*f" % (prec, float(r.get(field, 0) or 0) / div)

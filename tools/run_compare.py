@@ -44,7 +44,11 @@ import sys
 # better-direction heuristics, matched against the series base name
 # (lowercased, tags stripped).  Directionless names are context only.
 _UP_HINTS = ("acc", "f1", "per_sec", "throughput", "reward", "top",
-             "qps", "speedup")
+             "qps", "speedup",
+             # model-FLOP utilization: the efficiency denominator the
+             # cost-attribution arc added — it regresses by going DOWN
+             # (docs/observability.md "Cost attribution & MFU")
+             "mfu")
 _DOWN_HINTS = ("loss", "entropy", "err", "perplexity", "mae", "mse",
                "rmse", "time", "wait", "p50", "p90", "p99", "latency",
                # pipeline-parallel ladder metrics: the fill/drain bubble
@@ -64,7 +68,11 @@ _DOWN_HINTS = ("loss", "entropy", "err", "perplexity", "mae", "mse",
                # bytes regress by going up — a donation break or temp
                # blow-up shows here before the device OOMs
                # (docs/observability.md "HBM attribution")
-               "hbm_bytes")
+               "hbm_bytes",
+               # compile-time observability: cumulative XLA compile
+               # seconds regress by going up — a cache-miss storm (or a
+               # lost persistent-cache win) shows here
+               "compile_sec")
 
 _EVENT_TYPES = ("scalar", "span", "counter", "gauge", "hist", "summary")
 
@@ -243,6 +251,27 @@ def _load_bench(run, doc, path):
         run.groups["hbm"] = names
         if isinstance(hbm.get("config"), dict):
             run.identity["hbm"] = dict(hbm["config"])
+    # cost record (dryrun_multichip's per-program cost attribution,
+    # MULTICHIP_COST_*, or a bench record's efficiency block): numeric
+    # fields are gated headline metrics — mfu regresses by going DOWN
+    # (up-hint), compile_sec by going UP (down-hint), the FLOP counts
+    # are deterministic cross-checks; the nested config block (device
+    # count / batch shape) is IDENTITY, and the per-program breakdown
+    # rides under "programs" as context (rendered by
+    # tools/cost_report.py, not gated per-row)
+    cost = rec.get("cost") if isinstance(rec, dict) else None
+    if isinstance(cost, dict):
+        names = set()
+        for k, v in cost.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                run.bench[str(k)] = float(v)
+                names.add(str(k))
+        for name in run.bench:
+            if name.startswith("cost_") or name in ("mfu", "compile_sec"):
+                names.add(name)
+        run.groups["cost"] = names
+        if isinstance(cost.get("config"), dict):
+            run.identity["cost"] = dict(cost["config"])
     chained = (run.meta or {}).get("telemetry_scalars")
     if chained:
         for candidate in (chained,

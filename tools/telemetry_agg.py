@@ -461,6 +461,12 @@ def step_anatomy(per_rank, ratio=STRAGGLER_RATIO):
             row[phase + "_ms"] = totals[phase] / n / _US_PER_MS
         row["other_ms"] = max(
             0.0, row["step_ms"] - sum(totals.values()) / n / _US_PER_MS)
+        # the rank's last MFU gauge (fit loop, MXNET_PEAK_FLOPS): the
+        # efficiency column next to the time decomposition — absent
+        # when peaks were unset during the run
+        mfu = st.get("gauges", {}).get("mfu")
+        if isinstance(mfu, (int, float)):
+            row["mfu"] = float(mfu)
         table[rank] = row
     if not table:
         return {}
@@ -595,10 +601,13 @@ def render(agg, out=None):
     anatomy = agg.get("anatomy")
     if anatomy:
         cols = anatomy["phases"]
+        has_mfu = any("mfu" in rec for rec in anatomy["ranks"].values())
         out.write("\nStep anatomy (per-rank mean, ms/step)\n")
         out.write("%6s %8s %10s" % ("rank", "steps", "step_ms"))
         for p in cols:
             out.write(" %10s" % p)
+        if has_mfu:
+            out.write(" %10s" % "mfu")
         out.write("\n")
         for rank in sorted(anatomy["ranks"]):
             rec = anatomy["ranks"][rank]
@@ -606,6 +615,9 @@ def render(agg, out=None):
                                           rec["step_ms"]))
             for p in cols:
                 out.write(" %10.3f" % rec[p + "_ms"])
+            if has_mfu:
+                out.write(" %10s" % ("%.4f" % rec["mfu"]
+                                     if "mfu" in rec else "-"))
             out.write("\n")
         verdict = "STRAGGLER" if anatomy["straggler"] is not None else "ok"
         out.write("  slowest rank: %s (%.2fx the median of the other "
