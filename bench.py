@@ -371,6 +371,19 @@ def main():
     # measured input-pipeline shares (prefetch on vs synchronous staging)
     summary.update(pipeline)
     rec["telemetry"] = summary
+    # numerics-monitor context (null-safe: MXNET_MONITOR unset -> None).
+    # The bench's scan-fused run_steps chain is deliberately unmonitored
+    # (docs/observability.md), so an armed monitor rides as CONTEXT —
+    # what was sampled outside the timed region — never a gated metric;
+    # the gated overhead number lives in MULTICHIP_NUM_* records
+    from mxnet_tpu import numerics as num_mod
+    mspec = num_mod.spec()
+    rec["monitor"] = None if mspec is None else {
+        "every_n": mspec.every_n,
+        "stats": list(mspec.stats),
+        "sampled": len(num_mod.history()),
+        "last_global_grad_norm": num_mod.last_global_norm(),
+    }
     # serving round: concurrent batched server vs serialized baseline
     # (run_compare ingests the numeric fields as gated metrics)
     rec["serving"] = bench_serving()

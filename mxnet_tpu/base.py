@@ -53,6 +53,9 @@ TRACE_ENV_DEFAULTS = (
     ("MXNET_STEM_S2D", "0"),
     ("MXNET_POOL_MASK_BWD", "0"),
     ("MXNET_PALLAS_CONV", "auto"),
+    # numerics monitor: the spec decides whether the fused step traces
+    # the auxiliary stats pytree, so it must retrace on toggle
+    ("MXNET_MONITOR", ""),
 )
 
 

@@ -359,6 +359,16 @@ def snapshot(reason, exc=None, extra=None):
             }
     except Exception:   # diagnostics must never add a second failure
         pass
+    try:
+        from . import numerics as _num
+        numerics = _num.bundle_section()
+        if numerics:
+            # the MXNET_MONITOR history ring: recent sampled-step grad
+            # norms / update ratios / finite flags — the training-
+            # dynamics trail leading up to whatever this bundle records
+            bundle["numerics"] = numerics
+    except Exception:   # diagnostics must never add a second failure
+        pass
     if exc is not None:
         bundle["exception"] = {
             "type": type(exc).__name__,

@@ -399,21 +399,27 @@ class Module(BaseModule):
         self._exec_group.install_monitor(mon)
 
     # ------------------------------------------------- fused fit fast path
-    def _start_fused_fit(self, policy=None):
+    def _start_fused_fit(self, policy=None, monitor=None):
         """Return a TrainStep-backed per-batch trainer, or None.
 
         The reference's ``Module.fit`` IS its benchmarked path
         (base_module.py:369-518); here the executor + host-side optimizer
         loop leaves the TPU idle between kernels, so when the common case
         holds — one context, grad_req='write', a fused-optimizer-supported
-        update rule, no monitor/states/fixed params — fit's inner loop runs
+        update rule, no states/fixed params — fit's inner loop runs
         on the fused SPMD TrainStep instead: forward + backward + optimizer
         update as ONE donated XLA program per batch (mxnet_tpu/train.py).
         Disable with MXNET_FUSED_FIT=0.
 
         ``policy`` (an amp.Policy, or None to consult MXNET_AMP here at
         dispatch time) selects mixed-precision training: bf16 compute, f32
-        master weights, loss scaling carried inside the donated step."""
+        master weights, loss scaling carried inside the donated step.
+
+        ``monitor`` (a monitor.Monitor) rides the fused path when its
+        stat_func is the default RMS — its rows are then served from the
+        step's on-device numerics stats (the MXNET_MONITOR machinery)
+        instead of forcing the general path; a custom stat_func cannot be
+        traced into the step, so it falls back (the log line says so)."""
         import logging
         from ..base import get_env
         from .. import amp as _amp
@@ -443,6 +449,21 @@ class Module(BaseModule):
 
         if get_env("MXNET_FUSED_FIT", "1") == "0":
             return fallback("MXNET_FUSED_FIT=0")
+        if monitor is not None:
+            from .. import monitor as _mon_mod
+            if monitor.stat_func is not _mon_mod._rms:
+                # a custom stat_func is arbitrary host python — it cannot
+                # be traced into the donated step program
+                return fallback(
+                    "Monitor with a custom stat_func cannot be served "
+                    "from the fused step's on-device stats (the "
+                    "MXNET_MONITOR machinery samples the default RMS "
+                    "family only)")
+            logging.info(
+                "Module.fit: Monitor served from the fused step's "
+                "on-device numerics stats (parameter rows; per-op "
+                "activation streaming needs the general path — "
+                "MXNET_FUSED_FIT=0)")
         from .. import telemetry as _tel
         if _tel.enabled() and get_env("MXNET_TELEMETRY_FUSED", "0") != "1" \
                 and not (pp_req and pp_req > 1) and not zero_req:
@@ -473,7 +494,7 @@ class Module(BaseModule):
                 "dist" in getattr(self._kvstore, "type", ""):
             return fallback("dist kvstore")
         try:
-            return _FusedFit(self, policy)
+            return _FusedFit(self, policy, monitor=monitor)
         except MXNetError as e:
             from .. import sanitize as _san
             if isinstance(e, _san.SanitizerError):
@@ -524,6 +545,10 @@ def _fused_fit_key_fields(opt, policy):
         "pp_schedule": get_env("MXNET_PP_SCHEDULE", None),
         "pp_interleave": get_env("MXNET_PP_INTERLEAVE", None, typ=int),
         "zero": get_env("MXNET_ZERO", None, typ=int),
+        # MXNET_MONITOR on/off + spec: a monitored step traces the extra
+        # stats pytree, so toggling between fits must rebuild (and
+        # monitor-off must land back on the byte-identical plain step)
+        "monitor": _monitor_key(),
         # a live resize (parallel/resize.py) rewrites the MXTPU world
         # contract mid-process: a step traced for the old world must
         # never be reused at the new size, even if every other lever
@@ -537,15 +562,21 @@ def _ckpt_world():
     return _world()
 
 
+def _monitor_key():
+    from .. import numerics as _num
+    return _num.monitor_key()
+
+
 class _FusedFit(object):
     """Per-batch fused training engine behind Module.fit (see above)."""
 
-    def __init__(self, module, policy=None):
+    def __init__(self, module, policy=None, monitor=None):
         import jax
         from .. import sanitize as _san
         from ..train import TrainStep, PipelineTrainStep
         self._mod = module
         self._policy = policy
+        self._monitor = monitor
         # one XLA program per (optimizer config, precision policy,
         # trace-env snapshot): cache the compiled TrainStep on the module
         # — each fit() re-creates the optimizer, and rebuilding the step
@@ -649,6 +680,10 @@ class _FusedFit(object):
                        for n in self._ts.param_names}
         host_aux = {n: aux_params[n].asnumpy()
                     for n in self._ts.aux_names}
+        # logical element counts for the Monitor bridge (RMS = norm /
+        # sqrt(size); the ring entry carries norms only)
+        self._param_sizes = {n: int(v.size)
+                             for n, v in host_params.items()}
         state = self._ts.fopt.init_state(host_params)
         # updater continuity merges host-side so every placement path
         # below stages the finished state exactly once
@@ -852,6 +887,48 @@ class _FusedFit(object):
         """(loss_scale, overflow_delta) under a precision policy, else
         None.  Syncs two scalars — callers gate on telemetry."""
         return self._ts.amp_stats()
+
+    # ------------------------------------------------------ monitor bridge
+    def monitor_tic(self, monitor):
+        """Legacy Monitor bridge, tic half: the monitor armed itself for
+        this batch — force the step to sample its on-device stats pytree
+        even off the MXNET_MONITOR cadence (env unset included)."""
+        if monitor is not None and monitor._armed:
+            self._ts._mon_force = True
+
+    def monitor_feed(self, monitor):
+        """Legacy Monitor bridge, toc half: convert the sampled step's
+        ring entry into the monitor's ``(step, name, stat)`` rows —
+        parameter RMS (norm / sqrt(size)), the default stat over the
+        toc() argument snapshot — so ``toc()``/``toc_print()`` render,
+        stream and numerics-check them exactly as on the general path."""
+        import math as _math
+        if monitor is None or not monitor._armed:
+            return
+        entry = self.last_monitor_entry()
+        if entry is None:
+            return
+        for name, norm in sorted((entry.get("param_norms") or {}).items()):
+            if not monitor._name_ok(name):
+                continue
+            size = self._param_sizes.get(name)
+            if size:
+                monitor._rows.append((monitor._armed_step, name,
+                                      norm / _math.sqrt(size)))
+
+    def last_monitor_entry(self):
+        """The numerics ring entry published by the MOST RECENT step, or
+        None when that step did not sample."""
+        entry = getattr(self._ts, "_last_mon_entry", None)
+        if entry is None or entry.get("update") != self._ts.num_update - 1:
+            return None
+        return entry
+
+    def grad_norm(self):
+        """The most recent step's sampled global gradient norm (the
+        sentinel's watched series), or None off the sample cadence."""
+        entry = self.last_monitor_entry()
+        return entry.get("global_grad_norm") if entry else None
 
     def step(self, data_batch):
         """One fused step; returns (outputs, device_labels) as NDArrays.

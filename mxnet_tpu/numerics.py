@@ -1,0 +1,515 @@
+"""Jit-native numerics observatory — on-device training-dynamics
+telemetry plus non-finite provenance, without leaving the fused path.
+
+The observability arc attributes time (trace timeline), memory (HBM
+ledger) and compute cost (MFU/roofline) — this module watches the
+*numbers*.  ``MXNET_MONITOR=<every_n>[:grad,update,act][:raise]`` asks
+the fused TrainStep/PipelineTrainStep to return an auxiliary on-device
+scalar pytree on every ``every_n``-th update: per-parameter gradient L2
+norms, parameter norms, update/param ratios, the global gradient norm,
+and per-loss-head finite flags (optionally per-head activation RMS).
+The stats are computed INSIDE the jitted step (ZeRO's dp-sharded bucket
+rows reduce in-program; pipeline stages each report on their own
+sub-mesh), fetched in ONE planned device->host transfer per sampled
+step under ``sanitize.allow_sync``, and published as
+``grad_norm[param=...]`` / ``update_ratio[param=...]`` telemetry series
+plus a bounded in-memory history ring that rides diagnostics bundles as
+the ``numerics`` section (rendered by ``tools/numerics_report.py``).
+
+Second half — non-finite provenance: when a sampled step reports
+non-finite gradients (or AMP's overflow skip fires, or the loss goes
+NaN), the offending host batch is replayed through
+``executor._Lowered.run`` stage-by-stage, then op-by-op with
+``collect=True``, to name the FIRST op producing a non-finite value
+("stage 2, op conv3_bn fwd output inf at update 412") — written as a
+``numerics`` post-mortem bundle (the OOM post-mortem's twin).
+``:raise`` escalates the finding into a curated :class:`NumericsError`.
+
+Strict no-op contract: with ``MXNET_MONITOR`` unset nothing here is
+reached from a hot path, no ring exists, and the fused step's compiled
+program is byte-identical to a build without this module (pinned by
+tests).  The spec joins ``trace_env_key()`` and the fused-fit key
+fields so toggling rebuilds cleanly.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import warnings
+from collections import deque
+
+from .base import MXNetError, get_env
+from . import telemetry as _tel
+
+__all__ = ["NumericsError", "MonitorSpec", "parse_spec", "spec",
+           "monitor_key", "record", "history", "reset", "ring_capacity",
+           "last_global_norm", "worst_update_ratio", "bundle_section",
+           "publish", "investigate"]
+
+_STAT_NAMES = ("grad", "update", "act")
+_DEFAULT_RING = 64
+_EPS = 1e-12
+
+
+class NumericsError(MXNetError):
+    """MXNET_MONITOR=...:raise found non-finite training dynamics; the
+    message names the eviscerating op/stage and update count."""
+
+
+class MonitorSpec(object):
+    """Parsed ``MXNET_MONITOR`` value: sampling cadence, requested stat
+    groups, and the escalation switch."""
+
+    __slots__ = ("every_n", "stats", "raise_on_nonfinite")
+
+    def __init__(self, every_n, stats, raise_on_nonfinite):
+        self.every_n = int(every_n)
+        self.stats = tuple(stats)
+        self.raise_on_nonfinite = bool(raise_on_nonfinite)
+
+    def key(self):
+        """Hashable identity for cache keys (fused-fit key fields)."""
+        return (self.every_n, self.stats, self.raise_on_nonfinite)
+
+    def __repr__(self):
+        return "MonitorSpec(every_n=%d, stats=%s, raise=%s)" % (
+            self.every_n, ",".join(self.stats), self.raise_on_nonfinite)
+
+    def due(self, num_update):
+        """True when update ``num_update`` (0-based) is a sample step."""
+        return num_update % self.every_n == 0
+
+
+def parse_spec(raw):
+    """``<every_n>[:grad,update,act][:raise]`` -> :class:`MonitorSpec`,
+    or None for unset/``0`` (monitor off).  A malformed value raises
+    :class:`MXNetError` naming the grammar — a numerics watch that
+    silently parsed to "off" would be worse than no watch."""
+    if raw is None:
+        return None
+    raw = str(raw).strip()
+    if raw in ("", "0", "off", "false", "none"):
+        return None
+    parts = raw.split(":")
+    head = parts[0].strip()
+    if head in ("1", "on", "true") and len(parts) == 1 and \
+            not head.isdigit():
+        return MonitorSpec(1, ("grad", "update"), False)
+    try:
+        every_n = int(head)
+    except ValueError:
+        raise MXNetError(
+            "MXNET_MONITOR must be <every_n>[:grad,update,act][:raise], "
+            "got %r (leading field is not an integer)" % raw)
+    if every_n <= 0:
+        raise MXNetError(
+            "MXNET_MONITOR sampling cadence must be a positive integer, "
+            "got %d (use 0/unset to disable)" % every_n)
+    stats = ("grad", "update")
+    do_raise = False
+    for part in parts[1:]:
+        part = part.strip()
+        if not part:
+            continue
+        if part == "raise":
+            do_raise = True
+            continue
+        names = tuple(s.strip() for s in part.split(",") if s.strip())
+        bad = [s for s in names if s not in _STAT_NAMES]
+        if bad:
+            raise MXNetError(
+                "MXNET_MONITOR stat group(s) %s unknown (choose from %s)"
+                % (",".join(bad), ",".join(_STAT_NAMES)))
+        stats = names
+    return MonitorSpec(every_n, stats, do_raise)
+
+
+# memoized per raw env value: spec() sits on the fused __call__ path,
+# so the common monitor-off case must stay one env read + one compare
+_spec_memo = (object(), None)
+
+
+def spec():
+    """The active :class:`MonitorSpec`, or None while ``MXNET_MONITOR``
+    is unset (the strict no-op state)."""
+    global _spec_memo
+    raw = get_env("MXNET_MONITOR")
+    if _spec_memo[0] != raw:
+        _spec_memo = (raw, parse_spec(raw))
+    return _spec_memo[1]
+
+
+def monitor_key():
+    """Hashable monitor identity for ``_fused_fit_key_fields`` — None
+    while off, so monitor-off keys are unchanged from before this
+    module existed."""
+    s = spec()
+    return None if s is None else s.key()
+
+
+# --------------------------------------------------------- history ring
+_lock = threading.RLock()
+_ring = None          # deque(maxlen=ring_capacity()) once armed
+
+
+def ring_capacity():
+    """Bounded history length (``MXNET_MONITOR_RING``, default 64)."""
+    try:
+        cap = int(get_env("MXNET_MONITOR_RING", _DEFAULT_RING))
+    except (TypeError, ValueError):
+        warnings.warn("MXNET_MONITOR_RING=%r is not an integer; using %d"
+                      % (get_env("MXNET_MONITOR_RING"), _DEFAULT_RING))
+        cap = _DEFAULT_RING
+    return max(1, cap)
+
+
+def record(entry):
+    """Append one sampled-step entry to the bounded history ring."""
+    global _ring
+    with _lock:
+        if _ring is None:
+            _ring = deque(maxlen=ring_capacity())
+        _ring.append(dict(entry))
+
+
+def history():
+    """Snapshot of the history ring (oldest first)."""
+    with _lock:
+        return [dict(e) for e in _ring] if _ring else []
+
+
+def reset():
+    """Drop the ring and the spec memo (test helper)."""
+    global _ring, _spec_memo
+    with _lock:
+        _ring = None
+        _spec_memo = (object(), None)
+
+
+def last_global_norm():
+    """Most recent sampled global gradient norm, or None."""
+    with _lock:
+        entries = list(_ring) if _ring else []
+    for e in reversed(entries):
+        v = e.get("global_grad_norm")
+        if v is not None:
+            return v
+    return None
+
+
+def worst_update_ratio():
+    """Largest finite per-parameter update/param ratio seen in the ring,
+    or None."""
+    with _lock:
+        entries = list(_ring) if _ring else []
+    worst = None
+    for e in entries:
+        v = e.get("worst_update_ratio")
+        if v is None or not math.isfinite(v):
+            continue
+        if worst is None or v > worst:
+            worst = v
+    return worst
+
+
+def bundle_section():
+    """The ``numerics`` section of a diagnostics bundle, or None while
+    the ring is empty (an empty section would read as 'monitored and
+    clean', which unmonitored runs are not entitled to)."""
+    h = history()
+    if not h:
+        return None
+    s = spec()
+    return {
+        "spec": None if s is None else {
+            "every_n": s.every_n, "stats": list(s.stats),
+            "raise": s.raise_on_nonfinite},
+        "last_global_grad_norm": last_global_norm(),
+        "worst_update_ratio": worst_update_ratio(),
+        "history": h,
+    }
+
+
+# ------------------------------------------------------------- publish
+def publish(host_stats, update, spec_, who="train_step"):
+    """Fold one sampled step's fetched (host-side) stats pytree into the
+    telemetry stream and the history ring.  Returns the ring entry —
+    callers read ``entry["nonfinite_params"]`` / ``entry["heads_finite"]``
+    to decide whether provenance should fire.
+
+    ``host_stats`` fields (all optional, squared sums where noted):
+      ``grad_sq``   {param: float}  per-parameter gradient sq-sum
+      ``param_sq``  {param: float}  per-parameter weight sq-sum
+      ``upd_sq``    {param: float}  per-parameter update-delta sq-sum
+      ``grad_sq_global``  float     global gradient sq-sum
+      ``heads_finite``    [bool]    per-loss-head all-finite flags
+      ``act_rms``   {head: float}   per-head activation RMS
+    """
+    entry = {"update": int(update), "who": who}
+    tel_on = _tel._enabled
+    grad_sq = host_stats.get("grad_sq") or {}
+    param_sq = host_stats.get("param_sq") or {}
+    upd_sq = host_stats.get("upd_sq") or {}
+    nonfinite = []
+    grad_norms = {}
+    for name in sorted(grad_sq):
+        sq = float(grad_sq[name])
+        norm = math.sqrt(sq) if math.isfinite(sq) and sq >= 0 \
+            else float("nan")
+        grad_norms[name] = norm
+        if not math.isfinite(norm):
+            nonfinite.append(name)
+        if tel_on:
+            _tel.scalar("grad_norm", update, norm, param=name)
+    if grad_norms:
+        entry["grad_norms"] = grad_norms
+    gsq = host_stats.get("grad_sq_global")
+    if gsq is not None:
+        gsq = float(gsq)
+        gnorm = math.sqrt(gsq) if math.isfinite(gsq) and gsq >= 0 \
+            else float("nan")
+        entry["global_grad_norm"] = gnorm
+        if tel_on:
+            _tel.scalar("grad_norm", update, gnorm)
+            if math.isfinite(gnorm):
+                _tel.gauge("grad_global_norm", gnorm)
+    ratios = {}
+    worst = None
+    for name in sorted(upd_sq):
+        psq = float(param_sq.get(name, 0.0))
+        usq = float(upd_sq[name])
+        if not (math.isfinite(psq) and math.isfinite(usq)) \
+                or usq < 0 or psq < 0:
+            ratios[name] = float("nan")
+            continue
+        ratio = math.sqrt(usq) / (math.sqrt(psq) + _EPS)
+        ratios[name] = ratio
+        if worst is None or ratio > worst:
+            worst = ratio
+        if tel_on:
+            _tel.scalar("update_ratio", update, ratio, param=name)
+    if ratios:
+        entry["update_ratios"] = ratios
+    if worst is not None:
+        entry["worst_update_ratio"] = worst
+    param_norms = {}
+    for name in sorted(param_sq):
+        psq = float(param_sq[name])
+        param_norms[name] = math.sqrt(psq) \
+            if math.isfinite(psq) and psq >= 0 else float("nan")
+    if param_norms:
+        entry["param_norms"] = param_norms
+    heads = host_stats.get("heads_finite")
+    if heads is not None:
+        flags = [bool(h) for h in heads]
+        entry["heads_finite"] = flags
+        if tel_on and not all(flags):
+            _tel.counter("nonfinite_loss",
+                         sum(1 for f in flags if not f), where=who)
+    act = host_stats.get("act_rms")
+    if act:
+        rms = {}
+        for name in sorted(act):
+            v = float(act[name])
+            rms[name] = v
+            if tel_on:
+                _tel.scalar("act_rms", update, v, head=str(name))
+        entry["act_rms"] = rms
+    if nonfinite:
+        entry["nonfinite_params"] = nonfinite
+        if tel_on:
+            _tel.counter("nonfinite_grad", len(nonfinite), where=who)
+    record(entry)
+    return entry
+
+
+def entry_bad(entry):
+    """True when a published entry shows non-finite dynamics (bad grads,
+    a non-finite global norm, or a non-finite loss head)."""
+    if entry.get("nonfinite_params"):
+        return True
+    g = entry.get("global_grad_norm")
+    if g is not None and not math.isfinite(g):
+        return True
+    heads = entry.get("heads_finite")
+    if heads is not None and not all(heads):
+        return True
+    return False
+
+
+# -------------------------------------------------- non-finite provenance
+def _classify(x):
+    """'nan' | 'inf' | None for one replayed value (host transfer — the
+    provenance replay is a post-mortem, not a hot path)."""
+    import numpy as np
+    try:
+        import jax
+        a = np.asarray(jax.device_get(x))
+    except Exception:
+        a = np.asarray(x)
+    if not np.issubdtype(a.dtype, np.floating):
+        # ml_dtypes floats (bf16 / f8) register as kind 'V', not
+        # np.floating — and an AMP replay is exactly where they appear.
+        # Widening to f32 is exact for finiteness: every bf16/f8
+        # non-finite maps to the same f32 non-finite.
+        if a.dtype.kind != "V" or a.dtype.names is not None:
+            return None
+        try:
+            a = a.astype(np.float32)
+        except (TypeError, ValueError):
+            return None
+    if np.isnan(a).any():
+        return "nan"
+    if np.isinf(a).any():
+        return "inf"
+    return None
+
+
+def investigate(low, arg_vals, aux_vals, rng, update=None,
+                input_names=(), params_state="post-update",
+                num_stages=4, extra=None):
+    """Replay one (host-resident) bad step through
+    ``executor._Lowered.run`` to name the first non-finite producer.
+
+    Three passes, cheapest first:
+
+    1. **inputs** — a parameter/batch tensor that is already non-finite
+       going IN is the whole story (an injected inf weight, a poisoned
+       batch);
+    2. **stage-by-stage** — ``stage_partition`` the graph (best-effort;
+       graphs the pipeline cut rejects fall back to whole-graph) and run
+       each stage eagerly, checking its carry/outputs, to bound the
+       first bad region;
+    3. **op-by-op** — one ``collect=True`` replay (fusion disabled, true
+       per-op internals) walking the topo order to the FIRST op output
+       that classifies non-finite.
+
+    Returns a provenance dict (never raises — diagnostics must not add
+    a second failure); a clean forward replay reports
+    ``origin: "backward"`` so a gradient-only blow-up is still named as
+    such.  ``params_state`` documents whether the replayed weights are
+    the pre-update ones (AMP's overflow skip keeps them) or post-update.
+    """
+    prov = {"update": update, "params_state": params_state}
+    if extra:
+        prov.update(extra)
+    from . import sanitize as _san
+    try:
+        with _san.allow_sync("numerics provenance replay"):
+            # pass 1: non-finite inputs name themselves
+            bad_in = []
+            for name in sorted(arg_vals):
+                kind = _classify(arg_vals[name])
+                if kind:
+                    bad_in.append({"name": name, "kind": kind,
+                                   "input": "batch"
+                                   if name in input_names else "param"})
+            for name in sorted(aux_vals):
+                kind = _classify(aux_vals[name])
+                if kind:
+                    bad_in.append({"name": name, "kind": kind,
+                                   "input": "aux"})
+            if bad_in:
+                prov["bad_inputs"] = bad_in
+            # pass 2: stage bounds (best-effort — a graph the pipeline
+            # cut rejects, e.g. cross-stage weight sharing, replays whole)
+            n_ops = sum(1 for n in low.order if not n.is_var)
+            stages = None
+            if n_ops >= 2:
+                try:
+                    stages = low.stage_partition(
+                        min(int(num_stages), n_ops),
+                        input_names=input_names)
+                except MXNetError:
+                    stages = None
+            first_bad_stage = None
+            if stages is not None:
+                carry = []
+                for st in stages:
+                    outs, aux_upd, carry = low.run(
+                        arg_vals, aux_vals, rng, True, stage=st,
+                        carry_vals=carry)
+                    bad = None
+                    for v in list(carry) + list(outs):
+                        kind = _classify(v)
+                        if kind:
+                            bad = kind
+                            break
+                    if bad:
+                        first_bad_stage = {"stage": st.index,
+                                           "kind": bad,
+                                           "describe": st.describe()}
+                        break
+                if first_bad_stage:
+                    prov["first_bad_stage"] = first_bad_stage
+            # pass 3: op-by-op (collect=True disables fusion, so every
+            # true per-op internal is visible) — full graph, because
+            # collect and the stage path are mutually exclusive
+            outs, aux_upd, collected = low.run(arg_vals, aux_vals, rng,
+                                               True, collect=True)
+            op_stage = {}
+            if stages is not None:
+                for st in stages:
+                    for n in st.nodes:
+                        if not n.is_var:
+                            op_stage[id(n)] = st.index
+            first_op = None
+            for node in low.order:
+                if node.is_var:
+                    continue
+                n_vis = node.op.num_outputs_for(node.params)
+                for i in range(n_vis):
+                    nm = node.name + ("_output" if n_vis == 1
+                                      else "_output%d" % i)
+                    if nm not in collected:
+                        continue
+                    kind = _classify(collected[nm])
+                    if kind:
+                        first_op = {"op": node.name, "output": nm,
+                                    "op_type": node.op.name,
+                                    "kind": kind,
+                                    "stage": op_stage.get(id(node))}
+                        break
+                if first_op:
+                    break
+            if first_op:
+                prov["first_bad_op"] = first_op
+                prov["origin"] = "forward"
+                where = "op %s fwd output %s" % (first_op["op"],
+                                                 first_op["kind"])
+                if first_op.get("stage") is not None:
+                    where = "stage %d, %s" % (first_op["stage"], where)
+                prov["verdict"] = "%s at update %s" % (where, update)
+            elif bad_in:
+                b = bad_in[0]
+                prov["origin"] = "input"
+                prov["verdict"] = "%s %s %s going into the step at " \
+                    "update %s" % (b["input"], b["name"], b["kind"],
+                                   update)
+            else:
+                # the forward replay is clean: the blow-up is
+                # backward-only (a cotangent overflow the forward values
+                # never see) — name the worst gradient we sampled
+                prov["origin"] = "backward"
+                prov["verdict"] = ("backward-only non-finite (forward "
+                                   "replay clean) at update %s" % update)
+    except Exception as e:   # noqa: BLE001 — never add a second failure
+        prov["error"] = "%s: %s" % (type(e).__name__, e)
+    return prov
+
+
+def postmortem(prov, entry=None):
+    """Write the ``numerics`` post-mortem bundle (the OOM post-mortem's
+    twin) and return ``(path, message)``.  The bundle carries the
+    provenance verdict under ``extra.numerics_provenance`` next to the
+    ring's ``numerics`` section (added by diagnostics.snapshot)."""
+    from . import diagnostics as _diag
+    extra = {"numerics_provenance": dict(prov)}
+    if entry is not None:
+        extra["trigger"] = dict(entry)
+    path = _diag.write_snapshot("numerics", extra=extra)
+    msg = prov.get("verdict") or "non-finite training dynamics at " \
+        "update %s" % prov.get("update")
+    if path:
+        msg += " (numerics bundle: %s)" % path
+    return path, msg

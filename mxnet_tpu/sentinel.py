@@ -64,8 +64,14 @@ from . import telemetry as _tel
 
 __all__ = ["SentinelError", "SentinelWarning", "arm", "disarm", "armed",
            "step_close", "anatomy", "last_anatomy", "last_anomaly",
-           "digest", "name_straggler", "note_recompile", "reset",
-           "PHASES"]
+           "digest", "name_straggler", "note_recompile", "note_overflow",
+           "reset", "PHASES"]
+
+# opt-in extra watched series beyond the step anatomy: per-step MFU
+# (inverted z — utilization dropping is the regression) and the
+# MXNET_MONITOR global gradient norm (straight z — an exploding norm is
+# the regression); each is simply absent from the baseline when unfed
+_EXTRA_SERIES = ("mfu", "grad_norm")
 
 # the anatomy series: durations in seconds except comm_mb (wire-bytes
 # delta in MB — deviations are still detected per-series in sigma units,
@@ -257,6 +263,16 @@ def note_recompile(marker):
         _last_marker = str(marker)
 
 
+def note_overflow(marker="amp_overflow"):
+    """AMP's loss-scale automaton skipped an update (overflow): open a
+    quiet window, exactly like a declared recompile wave.  An overflow
+    burst legitimately perturbs the watched series — the scale halves,
+    the skipped update shifts step anatomy and drops the gradient norm —
+    and the automaton is already the component handling it; the sentinel
+    firing on top would be a duplicate finding.  No-op while disarmed."""
+    note_recompile(marker)
+
+
 def _wire_total():
     """Current wire-bytes ledger total (metadata only, never a sync)."""
     from . import sanitize as _san
@@ -267,14 +283,18 @@ def _wire_total():
 
 
 def step_close(total_s, data_wait_s, compute_s, epoch=None, nbatch=None,
-               mfu=None):
+               mfu=None, grad_norm=None):
     """Fold one completed fit step into the rolling baseline and run the
     anomaly check.  Called by ``Module.fit`` at step close, next to the
     ``step`` span — call sites guard with ``if sentinel._on:`` so the
     disarmed loop body is byte-for-byte the original.  ``mfu`` (the
     step's model-FLOP utilization, when peaks are configured) joins the
     watched series with an INVERTED z-score — efficiency falling is the
-    regression — and is simply absent from the baseline when None."""
+    regression — and is simply absent from the baseline when None.
+    ``grad_norm`` (MXNET_MONITOR's sampled global gradient norm) joins
+    with a straight z-score — an explosion names ``grad_norm`` as the
+    divergent phase; non-finite values are not folded (the numerics
+    monitor escalates those itself)."""
     if not _on or not _detect:
         return
     global _steps, _consec, _suppress, _last, _last_wire, _anomalies, \
@@ -294,7 +314,9 @@ def step_close(total_s, data_wait_s, compute_s, epoch=None, nbatch=None,
                "epoch": epoch, "nbatch": nbatch}
         if mfu is not None:
             row["mfu"] = float(mfu)
-        series = _SERIES + (("mfu",) if "mfu" in row else ())
+        if grad_norm is not None and math.isfinite(float(grad_norm)):
+            row["grad_norm"] = float(grad_norm)
+        series = _SERIES + tuple(s for s in _EXTRA_SERIES if s in row)
         _last = row
         # z-scores against the baseline BEFORE this sample folds in (a
         # rolling baseline that ate the anomalous step first would chase
@@ -351,7 +373,8 @@ def step_close(total_s, data_wait_s, compute_s, epoch=None, nbatch=None,
         elif zscores["step"] > _k_sigma:
             _consec += 1
             if _consec >= _consec_k:
-                watched = PHASES + (("mfu",) if "mfu" in zscores else ())
+                watched = PHASES + tuple(s for s in _EXTRA_SERIES
+                                         if s in zscores)
                 dom = max(watched, key=lambda p: zscores[p])
                 _anomalies += 1
                 anomaly = _last_anomaly = {
@@ -415,7 +438,7 @@ def anatomy():
             return None
         out = {s: {"mean": _ewma[s][0],
                    "sigma": math.sqrt(max(_ewma[s][1], 0.0))}
-               for s in _SERIES + ("mfu",) if s in _ewma}
+               for s in _SERIES + _EXTRA_SERIES if s in _ewma}
         return {"steps": _steps, "series": out,
                 "anomalies": _anomalies, "suppress": _suppress}
 
@@ -441,7 +464,7 @@ def digest():
         if not _on or not _detect or not _steps:
             return None
         d = {"steps": _steps}
-        for s in _SERIES + ("mfu",):
+        for s in _SERIES + _EXTRA_SERIES:
             if s in _ewma:
                 d[s] = round(_ewma[s][0], 9)
         return d

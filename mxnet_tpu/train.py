@@ -502,7 +502,8 @@ class TrainStep(object):
             layout = plan.bucket_layout(params, self.param_names)
             return (layout, plan.fold_bucket(gtree, params, layout, mesh))
 
-        def step(params, opt_state, aux, batch, rng, hyper, t):
+        def _step_core(want_stats, params, opt_state, aux, batch, rng,
+                       hyper, t):
             import jax.numpy as jnp
             # ZeRO-3: gather the flat parameter shards to full tensors
             # just-in-time (identity below level 3); XLA frees the
@@ -523,9 +524,24 @@ class TrainStep(object):
             new_aux = dict(aux)
             new_aux.update({k: v.astype(aux[k].dtype)
                             for k, v in aux_upd.items() if k in aux})
-            return new_params, new_state, new_aux, outs
+            if not want_stats:
+                return new_params, new_state, new_aux, outs
+            stats = self._monitor_stats(params, grads, new_params, outs)
+            return new_params, new_state, new_aux, outs, stats
 
-        def step_amp(params, opt_state, aux, lsc, batch, rng, hyper, t):
+        def step(params, opt_state, aux, batch, rng, hyper, t):
+            return _step_core(False, params, opt_state, aux, batch, rng,
+                              hyper, t)
+
+        def step_mon(params, opt_state, aux, batch, rng, hyper, t):
+            """MXNET_MONITOR sampled-step twin: identical update math
+            plus the on-device numerics stats pytree as a FIFTH output
+            (built lazily — monitor-off never traces it)."""
+            return _step_core(True, params, opt_state, aux, batch, rng,
+                              hyper, t)
+
+        def _amp_core(want_stats, params, opt_state, aux, lsc, batch, rng,
+                      hyper, t):
             """Loss-scaled step: the scale state ``lsc`` rides donated in
             the jit (and through run_steps' scan carry) — no host syncs."""
             import jax.numpy as jnp
@@ -587,16 +603,49 @@ class TrainStep(object):
             new_lsc = self.policy.next_state(lsc, finite)
             # the loss surface crosses back in f32 (metrics, sentinels)
             outs = tuple(o.astype(jnp.float32) for o in outs)
-            return new_params, new_state, new_aux, new_lsc, outs
+            if not want_stats:
+                return new_params, new_state, new_aux, new_lsc, outs
+            # stats OUTSIDE the overflow cond: the scaled grads exist on
+            # skip steps too (that step's inf IS the finding); the
+            # squared sums unscale by inv**2 so published norms are in
+            # unscaled units
+            stats = self._monitor_stats(params, grads, new_params, outs,
+                                        inv=inv)
+            return new_params, new_state, new_aux, new_lsc, outs, stats
+
+        def step_amp(params, opt_state, aux, lsc, batch, rng, hyper, t):
+            return _amp_core(False, params, opt_state, aux, lsc, batch,
+                             rng, hyper, t)
+
+        def step_amp_mon(params, opt_state, aux, lsc, batch, rng, hyper,
+                         t):
+            """MXNET_MONITOR sampled-step twin of the loss-scaled step:
+            the stats pytree rides as a SIXTH output."""
+            return _amp_core(True, params, opt_state, aux, lsc, batch,
+                             rng, hyper, t)
 
         # collision-proof program names: mxsan's raw-jit watcher exempts
         # this cache's inner names process-wide, so bare 'step'/'many'
         # would also blind it to same-named user functions
         step.__name__ = "mxtpu_step"
         step_amp.__name__ = "mxtpu_step_amp"
+        step_mon.__name__ = "mxtpu_step_mon"
+        step_amp_mon.__name__ = "mxtpu_step_amp_mon"
         self._step_fn = step_amp if self._has_scale else step
+        self._mon_fn = step_amp_mon if self._has_scale else step_mon
         self._donate = (0, 1, 2, 3) if self._has_scale else (0, 1, 2)
         self._multi_cache = {}
+        # MXNET_MONITOR: monitored-step programs keyed on the trace-env
+        # snapshot (the spec itself rides in TRACE_ENV_DEFAULTS, so a
+        # toggle rebuilds cleanly); built lazily on the first sampled
+        # step — monitor-off never jits a monitored variant
+        self._mon_cache = {}
+        self._mon_force = False      # legacy Monitor.tic() force-sample
+        self._last_mon_entry = None  # last published ring entry
+        self._san_mon_cache = _san.register_cache(
+            "train_step.monitor", kind="train_monitor", owner=self,
+            sizer=lambda ts: len(ts._mon_cache), warmup=4,
+            jit_names=("mxtpu_step_mon", "mxtpu_step_amp_mon"))
         self._hbm_done = False   # step program's HBM/cost capture (once)
         self._cost_row = None    # step program's cost ledger row (MFU)
         # mxsan: run_steps' chunk programs are a jit cache too (keyed on
@@ -1123,16 +1172,178 @@ class TrainStep(object):
         row = self._cost_row
         return row.get("flops") if row else None
 
+    # ------------------------------------------------------- numerics monitor
+    def _monitor_stats(self, params, grads, new_params, outs, inv=None):
+        """Trace-time numerics stats pytree (MXNET_MONITOR): squared
+        sums reduced ON DEVICE — the host takes square roots after the
+        one planned fetch.  ``grads`` is whatever the step's gradient
+        residency is: the ``(layout, bucket)`` pair under ZeRO>=2 (the
+        per-parameter stats slice the dp-sharded bucket columns, exactly
+        like ``plan.shard_update`` — flat-shard padding is zeros, so the
+        L2 sums are exact), the vjp tree otherwise.  ``inv`` (AMP)
+        unscales the squared sums by ``inv**2`` so published norms are
+        in unscaled units."""
+        import jax.numpy as jnp
+        from . import numerics as _num
+        spec = _num.spec()
+        stats_on = spec.stats if spec is not None else ("grad", "update")
+
+        def up(x):
+            # promote, never demote: bf16 grads reduce in f32, and an
+            # f64 parity run keeps f64 exactness (the MULTICHIP_NUM
+            # record gates the monitored norm against the replicated
+            # one at 1e-9 — an f32 reduction only reaches ~1e-7)
+            return x.astype(jnp.promote_types(x.dtype, jnp.float32))
+
+        def sq(x):
+            return jnp.sum(jnp.square(up(x)))
+        inv2 = None if inv is None else jnp.square(inv.astype(jnp.float32))
+        grad_sq = {}
+        if self.plan.bucket_grads:
+            layout, bucket = grads
+            if bucket is not None:
+                off = 0
+                for n, c in layout:
+                    s = sq(bucket[:, off:off + c])
+                    grad_sq[n] = s if inv2 is None else s * inv2
+                    off += c
+        else:
+            for n in self.param_names:
+                s = sq(grads[n])
+                grad_sq[n] = s if inv2 is None else s * inv2
+        total = jnp.float32(0.0)
+        for s in grad_sq.values():
+            total = total + s
+        stats = {"grad_sq_global": total,
+                 "heads_finite": tuple(jnp.isfinite(o).all()
+                                       for o in outs)}
+        if "grad" in stats_on:
+            stats["grad_sq"] = grad_sq
+        if "update" in stats_on:
+            # ZeRO-3 flat rows are elementwise-valid here: padding is
+            # zeros in both the old and the new parameters
+            stats["param_sq"] = {n: sq(params[n])
+                                 for n in self.param_names}
+            stats["upd_sq"] = {
+                n: sq(up(new_params[n]) - up(params[n]))
+                for n in self.param_names}
+        if "act" in stats_on:
+            stats["act_rms"] = {
+                "head%d" % i: jnp.sqrt(jnp.mean(jnp.square(up(o))))
+                for i, o in enumerate(outs)}
+        return stats
+
+    def _monitored_step(self):
+        """The monitored-step program for the CURRENT trace env, built
+        lazily on the first sampled step (monitor-off never reaches
+        this, so the unmonitored program stays byte-identical)."""
+        import jax
+        key = trace_env_key()
+        fn = self._mon_cache.get(key)
+        if fn is not None:
+            return fn
+        if self.mesh is not None:
+            if self._has_scale:
+                fn = jax.jit(self._mon_fn,
+                             in_shardings=self._in_shardings,
+                             out_shardings=self._out_shardings + (None,),
+                             donate_argnums=(0, 1, 2, 3),
+                             compiler_options=_xla_options())
+            else:
+                fn = jax.jit(self._mon_fn,
+                             in_shardings=self._in_shardings,
+                             donate_argnums=(0, 1, 2),
+                             compiler_options=_xla_options())
+        else:
+            fn = jax.jit(self._mon_fn, donate_argnums=self._donate,
+                         compiler_options=_xla_options())
+        self._mon_cache[key] = fn
+        self._san_mon_cache.miss({"trace_env": key})
+        return fn
+
+    def _publish_monitor(self, stats_dev, res, batch, rng, upd_idx, mspec):
+        """Fetch the sampled step's stats (the ONE planned d2h), publish
+        them to telemetry + the history ring, and — on non-finite
+        dynamics — run the provenance replay, write the ``numerics``
+        post-mortem bundle, and escalate per the spec."""
+        import jax
+        import warnings
+        from . import numerics as _num
+        with _san.allow_sync("numerics monitor fetch"):
+            host = jax.device_get(stats_dev)
+        entry = _num.publish(host, upd_idx, mspec, who="train_step")
+        self._last_mon_entry = entry
+        if not _num.entry_bad(entry):
+            return entry
+        prov = self._numerics_provenance(res, batch, rng, upd_idx)
+        path, msg = _num.postmortem(prov, entry=entry)
+        if mspec is not None and mspec.raise_on_nonfinite:
+            raise _num.NumericsError(msg)
+        warnings.warn("mxnet_tpu numerics monitor: %s" % msg)
+        return entry
+
+    def _numerics_provenance(self, res, batch, rng, upd_idx):
+        """Host replay of a bad step through ``executor._Lowered.run``
+        (stage-by-stage, then op-by-op).  The step's inputs are donated,
+        so the replay uses the RETURNED params — exactly the pre-step
+        weights when AMP's overflow skip fired (the common non-finite
+        trigger), post-update otherwise (the bundle says which)."""
+        import jax
+        from . import numerics as _num
+        params_state = "pre-update (AMP overflow skip)" \
+            if self._has_scale else "post-update"
+        with _san.allow_sync("numerics provenance host pull"):
+            params = {n: _np.asarray(jax.device_get(v))
+                      for n, v in self.gather_params(res[0]).items()}
+            aux = {n: _np.asarray(jax.device_get(v))
+                   for n, v in res[2].items()}
+            vals = {k: _np.asarray(jax.device_get(v))
+                    for k, v in batch.items()}
+        if self._dtype is not None:
+            vals = {k: (v.astype(self._dtype)
+                        if k not in self.label_names
+                        and v.dtype == _np.float32 else v)
+                    for k, v in vals.items()}
+            params = {k: v.astype(self._dtype) for k, v in params.items()}
+        arg_vals = dict(vals)
+        arg_vals.update(params)
+        inputs = set(self.data_names) | set(self.label_names)
+        return _num.investigate(self._low, arg_vals, aux, rng,
+                                update=upd_idx, input_names=inputs,
+                                params_state=params_state)
+
     # ------------------------------------------------------------------- call
     def __call__(self, params, opt_state, aux, batch, rng=None):
         """One fused step.  Returns (params, opt_state, aux, outputs)."""
         from . import profiler as _profiler
         from . import telemetry as _tel
         from . import diagnostics as _diag
+        from . import numerics as _num
         if rng is None:
             rng = _random.next_key()
+        upd_idx = self.num_update
         hyper = self.fopt.hyper(self.num_update)
         self.num_update += 1
+        mspec = _num.spec()
+        # the legacy Monitor bridge force-samples even with MXNET_MONITOR
+        # unset (the stats trace then uses the default grad+update set)
+        sample = self._mon_force or (mspec is not None
+                                     and mspec.due(upd_idx))
+        if self._mon_force:
+            self._mon_force = False
+        step_prog = self._monitored_step() if sample else self._step
+        if sample and self.plan.bucket_grads \
+                and (_san._collective_on or _tel._enabled):
+            # the per-parameter squared sums reduce across the
+            # dp-sharded bucket rows inside the monitored program — a
+            # psum the collective ledger should see
+            n_scalars = len(self.param_names) + 1
+            if _san._collective_on:
+                _san.note_collective(
+                    "mxtpu_monitor_psum", name="grad_stats",
+                    sig=("%d scalars" % n_scalars,), axes="dp")
+            _san.record_wire_bytes("mxtpu_monitor_psum", axes="dp",
+                                   nbytes=4 * n_scalars)
         args = (params, opt_state, aux)
         if self._has_scale:
             args = args + (self._scale_state_dev(),)
@@ -1159,14 +1370,14 @@ class TrainStep(object):
             if _tel._enabled:
                 with _tel.span("train_step", cat="executor", mirror=False,
                                num_update=self.num_update):
-                    res = self._step(*args, batch, rng, hyper,
-                                     _np.int32(self.num_update))
+                    res = step_prog(*args, batch, rng, hyper,
+                                    _np.int32(self.num_update))
                     import jax
                     with _san.allow_sync("telemetry span device time"):
                         jax.block_until_ready(res[-1])
             else:
-                res = self._step(*args, batch, rng, hyper,
-                                 _np.int32(self.num_update))
+                res = step_prog(*args, batch, rng, hyper,
+                                _np.int32(self.num_update))
                 if _profiler.is_running():
                     import jax
                     with _san.allow_sync("profiler device time"):
@@ -1174,6 +1385,10 @@ class TrainStep(object):
         if _san._donate_on:
             _san.note_donated("train_step", self._donate_pairs(args),
                               step=self.num_update)
+        stats_dev = None
+        if sample:
+            stats_dev = res[-1]
+            res = res[:-1]
         if self._has_scale:
             self._scale_state = res[3]
             res = (res[0], res[1], res[2], res[4])
@@ -1201,6 +1416,9 @@ class TrainStep(object):
             # outputs (loss heads) are the observable surface here
             _diag.check_outputs(res[3], mode, where="train_step",
                                 num_update=self.num_update)
+        if stats_dev is not None:
+            self._publish_monitor(stats_dev, res, batch, rng, upd_idx,
+                                  mspec)
         return res
 
 
@@ -1449,16 +1667,23 @@ class PipelineTrainStep(object):
         # time from shape metadata — no syncs); mirrors the
         # pp_stage<N>_live_bytes gauges, readable with telemetry off
         self.last_live_bytes = None
+        # MXNET_MONITOR state (mirrors TrainStep): force-sample hook for
+        # the legacy Monitor bridge + the last published ring entry
+        self._mon_force = False
+        self._last_mon_entry = None
         # mxsan RECOMPILE: the per-(kind, stage, trace-env) program cache
         # (CKEY001 CACHES entry: tools/mxlint/rule_ckey.py).  One env
         # snapshot costs at most fwd/bwd/upd/zeros per virtual stage plus
-        # the AMP fin/auxsel/scale and overlap gather programs.
+        # the AMP fin/auxsel/scale and overlap gather programs — and,
+        # under MXNET_MONITOR, a stats program per virtual stage plus the
+        # final stage's loss-head finite/RMS program.
         self._san_cache = _san.register_cache(
             "pipeline.stages", kind="pipeline", owner=self,
-            sizer=lambda ps: len(ps._progs), warmup=8 * self._V + 2,
+            sizer=lambda ps: len(ps._progs), warmup=9 * self._V + 3,
             jit_names=("mxtpu_pp_fwd", "mxtpu_pp_bwd", "mxtpu_pp_upd",
                        "mxtpu_pp_zeros", "mxtpu_pp_fin", "mxtpu_pp_scale",
-                       "mxtpu_pp_auxsel", "mxtpu_pp_gather"))
+                       "mxtpu_pp_auxsel", "mxtpu_pp_gather",
+                       "mxtpu_pp_stats", "mxtpu_pp_headsfin"))
         # the dispatch-plan cache: per-(schedule, interleave, M, trace-env)
         # merged work-item order + its simulated bubble (CKEY001 CACHES
         # entry; pure host-side python — the plan's stage programs land in
@@ -2082,6 +2307,74 @@ class PipelineTrainStep(object):
             auxsel.__name__ = "mxtpu_pp_auxsel"
             return jax.jit(auxsel, out_shardings=rep)
 
+        if kind == "stats":
+            # MXNET_MONITOR: this stage's numerics stats on its sub-mesh
+            # — squared sums of whatever the gradient residency is when
+            # the stats dispatch runs (the flat (dp, chunk) bucket when
+            # ZeRO keeps it, the gathered/accumulated tree otherwise);
+            # the dp-sharded bucket reduction crosses ranks in-program.
+            # The update/param ratio is structurally unavailable here:
+            # the pre-update params are donated into the stage update
+            # programs, so old and new params never coexist.
+            from . import numerics as _num
+            flat = overlap and self.zero
+            spec_ = _num.spec()
+            want_upd = spec_ is None or "update" in spec_.stats
+
+            def stats_core(params, grads, inv=None):
+                def sq(x):
+                    # promote, never demote (f64 parity runs stay exact)
+                    return jnp.sum(jnp.square(x.astype(
+                        jnp.promote_types(x.dtype, jnp.float32))))
+                inv2 = None if inv is None \
+                    else jnp.square(inv.astype(jnp.float32))
+                grad_sq = {}
+                if flat:
+                    off = 0
+                    for n, c in bucket_chunks(params):
+                        gs = sq(grads[:, off:off + c])
+                        grad_sq[n] = gs if inv2 is None else gs * inv2
+                        off += c
+                else:
+                    for n in names:
+                        gs = sq(grads[n])
+                        grad_sq[n] = gs if inv2 is None else gs * inv2
+                out = {"grad_sq": grad_sq}
+                if want_upd:
+                    # ZeRO-3 flat rows are elementwise-valid (padding is
+                    # zeros), so the squared sums are exact
+                    out["param_sq"] = {n: sq(params[n]) for n in names}
+                return out
+
+            if self._has_scale:
+                def stats(params, grads, inv):
+                    return stats_core(params, grads, inv)
+            else:
+                def stats(params, grads):
+                    return stats_core(params, grads)
+            stats.__name__ = "mxtpu_pp_stats"
+            return jax.jit(stats)
+
+        if kind == "headsfin":
+            # MXNET_MONITOR: loss-head finite flags (+ optional RMS) on
+            # the final stage's sub-mesh, over the concatenated outputs
+            from . import numerics as _num
+            spec_ = _num.spec()
+            want_act = spec_ is not None and "act" in spec_.stats
+
+            def headsfin(outs):
+                out = {"heads_finite": tuple(jnp.isfinite(o).all()
+                                             for o in outs)}
+                if want_act:
+                    out["act_rms"] = {
+                        "head%d" % i: jnp.sqrt(jnp.mean(jnp.square(
+                            o.astype(jnp.promote_types(o.dtype,
+                                                       jnp.float32)))))
+                        for i, o in enumerate(outs)}
+                return out
+            headsfin.__name__ = "mxtpu_pp_headsfin"
+            return jax.jit(headsfin)
+
         raise MXNetError("unknown pipeline program kind %r" % kind)
 
     # ------------------------------------------------------------ transfers
@@ -2158,6 +2451,84 @@ class PipelineTrainStep(object):
         busy[s] += _time.perf_counter() - t0
         return out
 
+    # ----------------------------------------------------- numerics monitor
+    def _publish_monitor(self, stats_s, heads_stats, new_params, new_aux,
+                         batch, rng, upd_idx, mspec):
+        """Merge the per-stage stats pytrees (fetched in ONE planned
+        d2h), publish them, and on non-finite dynamics run the
+        provenance replay + ``numerics`` post-mortem.  No update/param
+        ratio on this path — the stage updates donate the pre-update
+        params before the post-update ones exist."""
+        import jax
+        import warnings
+        from . import numerics as _num
+        with _san.allow_sync("numerics monitor fetch"):
+            host_s, host_h = jax.device_get((stats_s, heads_stats))
+        grad_sq, param_sq = {}, {}
+        for st in host_s:
+            grad_sq.update(st.get("grad_sq") or {})
+            param_sq.update(st.get("param_sq") or {})
+        host = {"grad_sq": grad_sq}
+        if grad_sq:
+            host["grad_sq_global"] = float(sum(
+                float(v) for v in grad_sq.values()))
+        if param_sq:
+            host["param_sq"] = param_sq
+        if host_h:
+            host["heads_finite"] = host_h.get("heads_finite")
+            if host_h.get("act_rms"):
+                host["act_rms"] = host_h["act_rms"]
+        entry = _num.publish(host, upd_idx, mspec, who="pipeline_step")
+        self._last_mon_entry = entry
+        if not _num.entry_bad(entry):
+            return entry
+        prov = self._numerics_provenance(new_params, new_aux, batch, rng,
+                                         upd_idx)
+        path, msg = _num.postmortem(prov, entry=entry)
+        if mspec is not None and mspec.raise_on_nonfinite:
+            raise _num.NumericsError(msg)
+        warnings.warn("mxnet_tpu numerics monitor: %s" % msg)
+        return entry
+
+    def _numerics_provenance(self, new_params, new_aux, batch, rng,
+                             upd_idx):
+        """Host replay through the stage partition, then op-by-op.  The
+        pre-update params were donated into the stage update programs,
+        so the replay uses the RETURNED ones — exactly the pre-step
+        weights when AMP's overflow skip fired (the common non-finite
+        trigger), post-update otherwise (the bundle says which)."""
+        import jax
+        from . import numerics as _num
+        params_state = "pre-update (AMP overflow skip)" \
+            if self._has_scale else "post-update"
+        with _san.allow_sync("numerics provenance host pull"):
+            host_p = {n: _np.asarray(jax.device_get(v))
+                      for n, v in new_params.items()}
+            host_aux = {n: _np.asarray(jax.device_get(v))
+                        for n, v in new_aux.items()}
+            host_b = {k: _np.asarray(jax.device_get(v))
+                      for k, v in batch.items()}
+        if self.zero >= 3:
+            host_p = {n: self.plan.unflatten_host(n, v)
+                      for n, v in host_p.items()}
+        if self._dtype is not None:
+            host_b = {k: (v.astype(self._dtype)
+                          if k not in self.label_names
+                          and v.dtype == _np.float32 else v)
+                      for k, v in host_b.items()}
+            host_p = {k: v.astype(self._dtype)
+                      for k, v in host_p.items()}
+        arg_vals = dict(host_b)
+        arg_vals.update(host_p)
+        return _num.investigate(self._low, arg_vals, host_aux, rng,
+                                update=upd_idx,
+                                input_names=self._inputs_all,
+                                params_state=params_state,
+                                num_stages=self._V,
+                                extra={"pp": self._pp, "dp": self._dp,
+                                       "schedule": self._schedule,
+                                       "interleave": self._v})
+
     # ------------------------------------------------------------------ call
     def __call__(self, params, opt_state, aux, batch, rng=None):
         """One pipelined, microbatched global step under the configured
@@ -2192,6 +2563,15 @@ class PipelineTrainStep(object):
                 "pipeline step: microbatch %d (batch %d / M=%d) is not "
                 "divisible by dp=%d" % (mb, b0, M, self._dp))
         plan = self._get_plan()
+        from . import numerics as _num
+        upd_idx = self.num_update
+        mspec = _num.spec()
+        # the legacy Monitor bridge force-samples even with MXNET_MONITOR
+        # unset (the stats trace then uses the default grad+update set)
+        sample = self._mon_force or (mspec is not None
+                                     and mspec.due(upd_idx))
+        if self._mon_force:
+            self._mon_force = False
         hyper = self.fopt.hyper(self.num_update)
         self.num_update += 1
         t = _np.int32(self.num_update)
@@ -2324,6 +2704,32 @@ class PipelineTrainStep(object):
                 fin_d = [self._put_carry((finite,), d)[0]
                          for d in range(P)]
                 inv_d = [self._put_carry((inv,), d)[0] for d in range(P)]
+            # ---- sampled numerics stats, per stage on its sub-mesh —
+            # dispatched BEFORE the updates donate the stage params
+            stats_s = None
+            if sample:
+                stats_s = []
+                for k in range(V):
+                    d = k % P
+                    src = acc[k]
+                    if gather_grads:
+                        src = grads_full[k] if grads_full[k] is not None \
+                            else {}
+                    if self._bucket and self.zero and self._dp > 1 \
+                            and _san._collective_on \
+                            and self._stages[k].params:
+                        # the per-param squared sums reduce across the
+                        # bucket's dp rows inside the stats program
+                        _san.note_collective(
+                            "mxtpu_monitor_psum", name="stage%d" % k,
+                            sig=("%d scalars"
+                                 % len(self._stages[k].params),),
+                            axes="dp")
+                    call = [p_s[k], src]
+                    if self._has_scale:
+                        call.append(inv_d[d])
+                    stats_s.append(self._timed(
+                        busy, d, self._get_prog("stats", k), *call))
             # ---- per-stage optimizer update (ZeRO-1 shards over the
             # stage sub-mesh's dp axis); donated params/state
             new_params, new_state, new_aux = {}, {}, {}
@@ -2353,6 +2759,10 @@ class PipelineTrainStep(object):
                 outs = tuple(jnp.concatenate([om[i] for om in outs_m],
                                              axis=0)
                              for i in range(len(outs_m[0])))
+            heads_stats = None
+            if sample:
+                heads_stats = self._timed(
+                    busy, P - 1, self._get_prog("headsfin", V - 1), outs)
         if _san._donate_on:
             _san.note_donated("pipeline_step",
                               self._donate_pairs(args_led),
@@ -2416,4 +2826,7 @@ class PipelineTrainStep(object):
         if mode is not None:
             _diag.check_outputs(outs, mode, where="pipeline_step",
                                 num_update=self.num_update)
+        if stats_s is not None:
+            self._publish_monitor(stats_s, heads_stats, new_params,
+                                  new_aux, batch, rng, upd_idx, mspec)
         return new_params, new_state, new_aux, outs

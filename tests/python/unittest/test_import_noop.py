@@ -121,6 +121,16 @@ assert _cost._cache is None, "roofline peaks resolved at import"
 assert _dist._sent_seq == 0, "sentinel digest exchange advanced"
 assert _dist.straggler() is None, "straggler verdict exists"
 
+# numerics monitor: with MXNET_MONITOR unset there is no spec, no
+# history ring, and no bundle section — the fused step's dispatch gate
+# is one env read + one compare
+import mxnet_tpu.numerics as _num
+assert _num._ring is None, "numerics history ring pre-created"
+assert _num.spec() is None, "numerics monitor armed"
+assert _num.monitor_key() is None, "monitor key set while disarmed"
+assert _num.history() == [], "numerics ring grew while disarmed"
+assert _num.bundle_section() is None, "numerics bundle section exists"
+
 new_threads = [t.name for t in threading.enumerate()
                if t.ident not in baseline_threads]
 print("RESULT " + json.dumps({"threads": new_threads, **created}))

@@ -228,6 +228,53 @@ def render(bundle, out=None, events=10, stacks=True):
             out.write("  compile %-24s %10.3f s\n"
                       % ("TOTAL", comp["total"]))
 
+    num = bundle.get("numerics")
+    prov = (bundle.get("extra") or {}).get("numerics_provenance")
+    if num or prov:
+        out.write("\nNumerics monitor\n")
+        spec = (num or {}).get("spec") or {}
+        if spec:
+            out.write("  spec         every_n=%s stats=%s%s\n"
+                      % (spec.get("every_n"),
+                         ",".join(spec.get("stats") or ()),
+                         " :raise" if spec.get("raise") else ""))
+        if num:
+            out.write("  last global grad norm  %s\n"
+                      % num.get("last_global_grad_norm"))
+            if num.get("worst_update_ratio") is not None:
+                out.write("  worst update/param     %.3g\n"
+                          % num["worst_update_ratio"])
+            history = num.get("history") or []
+            bad = [e for e in history
+                   if e.get("nonfinite_params")
+                   or (e.get("heads_finite") is not None
+                       and not all(e["heads_finite"]))]
+            out.write("  sampled      %d update(s), %d non-finite\n"
+                      % (len(history), len(bad)))
+            for e in bad[-3:]:
+                out.write("    update %-6s bad: %s\n"
+                          % (e.get("update"),
+                             ", ".join(e.get("nonfinite_params")
+                                       or ["loss head"])))
+        if prov:
+            out.write("  PROVENANCE   %s\n"
+                      % (prov.get("verdict")
+                         or "replay inconclusive (%s)"
+                         % prov.get("error", "no verdict")))
+            fb = prov.get("first_bad_op")
+            if fb:
+                out.write("    first bad op %s (%s) output %s  kind %s%s\n"
+                          % (fb.get("op"), fb.get("op_type"),
+                             fb.get("output"), fb.get("kind"),
+                             "  stage %s" % fb["stage"]
+                             if fb.get("stage") is not None else ""))
+            for b in (prov.get("bad_inputs") or [])[:4]:
+                out.write("    bad input    %s %s (%s)\n"
+                          % (b.get("input"), b.get("name"),
+                             b.get("kind")))
+            out.write("    full history: tools/numerics_report.py "
+                      "<this bundle>\n")
+
     fr = bundle.get("flight_recorder")
     if fr:
         out.write("\nFlight recorder (ring of %s, %s recorded)\n"

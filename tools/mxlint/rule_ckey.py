@@ -58,6 +58,14 @@ CACHES = (
     {"name": "PipelineTrainStep._progs",
      "key": ("mxnet_tpu/train.py", "PipelineTrainStep._get_prog"),
      "roots": (("mxnet_tpu/executor.py", "_Lowered.run"),)},
+    # the sampled numerics-monitor step (MXNET_MONITOR): one extra jit
+    # per trace-env snapshot, traced over the same forward as the plain
+    # step plus the on-device stats tree — MXNET_MONITOR itself sits in
+    # TRACE_ENV_DEFAULTS so the stats layout (grad/update/act) is keyed
+    {"name": "TrainStep._mon_cache (numerics monitor)",
+     "key": ("mxnet_tpu/train.py", "TrainStep._monitored_step"),
+     "roots": (("mxnet_tpu/executor.py", "_Lowered.run"),
+               ("mxnet_tpu/numerics.py", "spec"))},
     # the schedule dispatch-plan cache (schedule-v2 PR): pure host-side
     # python —
     # the work-item generators in parallel/schedule.py read no env — but

@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Render a numerics-monitor history: sampled training-dynamics rows
+(global/per-param gradient norms, update/param ratios, loss-head finite
+flags) and, when present, the non-finite provenance verdict.
+
+``MXNET_MONITOR=<every_n>[:grad,update,act][:raise]`` arms the jit-native
+numerics observatory (mxnet_tpu/numerics.py): sampled fused steps return
+an on-device scalar stats pytree that lands in a bounded history ring,
+which rides diagnostics bundles as the ``numerics`` section; a sampled
+non-finite step adds a ``numerics`` post-mortem bundle whose
+``extra.numerics_provenance`` names the first bad op.  This tool renders
+both for humans and CI:
+
+    python tools/numerics_report.py mxtpu_diag.numerics.pid1234.json
+    python tools/numerics_report.py bundle.json --json
+    python tools/numerics_report.py bundle.json --last 5
+
+Accepts a diagnostics bundle (reads its ``numerics`` section plus any
+``extra.numerics_provenance``) or a bare section document
+``{spec, history, ...}``.  Rows are the ring's sampled updates, oldest
+first.  Pure stdlib.  Table layout shared with hbm/cost_report via
+ledger_table.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+
+def _sibling(name):
+    """Load a sibling tool as a library (tools/ is not a package) — the
+    telemetry_report idiom."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "%s.py" % name)
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_numerics(path):
+    """``{"section", "provenance", "trigger"}`` from a diagnostics
+    bundle's ``numerics`` section (plus ``extra.numerics_provenance``
+    when the bundle is a post-mortem), or a bare section document.
+    Raises ValueError when the file is neither."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError("%s: not a JSON object" % path)
+    prov = None
+    trigger = None
+    if doc.get("type") == "mxtpu_diagnostics":
+        extra = doc.get("extra") or {}
+        prov = extra.get("numerics_provenance")
+        trigger = extra.get("trigger")
+        section = doc.get("numerics")
+        if not section and not prov:
+            raise ValueError(
+                "%s: diagnostics bundle has no 'numerics' section — was "
+                "MXNET_MONITOR armed (and had a step been sampled) when "
+                "it was written?" % path)
+        doc = section or {}
+    if not isinstance(doc.get("history"), list) and prov is None:
+        raise ValueError("%s: neither a diagnostics bundle nor a "
+                         "numerics section document" % path)
+    return {"section": doc, "provenance": prov, "trigger": trigger}
+
+
+def _fin(v):
+    return v is not None and isinstance(v, (int, float)) \
+        and math.isfinite(v)
+
+
+def summarize(num):
+    """Ring rows (oldest first) + headline fields + provenance."""
+    section = num.get("section") or {}
+    history = [e for e in section.get("history") or []
+               if isinstance(e, dict)]
+    rows = []
+    for e in history:
+        grad_norms = e.get("grad_norms") or {}
+        ratios = e.get("update_ratios") or {}
+        heads = e.get("heads_finite")
+        worst_param = None
+        if grad_norms:
+            finite = {k: v for k, v in grad_norms.items() if _fin(v)}
+            if finite:
+                worst_param = max(finite, key=lambda k: finite[k])
+        rows.append({
+            "update": e.get("update"),
+            "who": e.get("who"),
+            "global_grad_norm": e.get("global_grad_norm"),
+            "worst_update_ratio": e.get("worst_update_ratio"),
+            "n_params": len(grad_norms) or len(ratios) or None,
+            "worst_grad_param": worst_param,
+            "heads_finite": heads,
+            "nonfinite_params": e.get("nonfinite_params") or [],
+            "bad": bool(e.get("nonfinite_params"))
+            or (e.get("global_grad_norm") is not None
+                and not _fin(e.get("global_grad_norm")))
+            or (heads is not None and not all(heads)),
+        })
+    return {
+        "spec": section.get("spec"),
+        "last_global_grad_norm": section.get("last_global_grad_norm"),
+        "worst_update_ratio": section.get("worst_update_ratio"),
+        "rows": rows,
+        "bad_updates": [r["update"] for r in rows if r["bad"]],
+        "provenance": num.get("provenance"),
+        "trigger": num.get("trigger"),
+    }
+
+
+def _num_cell(field, prec=4):
+    def fmt(r):
+        v = r.get(field)
+        if v is None:
+            return "-"
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            return str(v)
+        if not math.isfinite(v):
+            return "NONFINITE"
+        return "%.*g" % (prec, v)
+    return fmt
+
+
+def render(summary, out=None, last=None):
+    out = sys.stdout if out is None else out
+    lt = _sibling("ledger_table")
+    rows = summary["rows"]
+    spec = summary.get("spec")
+    title = "Numerics monitor history (%d sampled update(s))" % len(rows)
+    if spec:
+        title += " — every_n=%s stats=%s%s" % (
+            spec.get("every_n"), ",".join(spec.get("stats") or ()),
+            " :raise" if spec.get("raise") else "")
+    shown = rows[-last:] if last else rows
+    table = [("upd %s%s" % (r.get("update"),
+                            " !" if r["bad"] else ""), r)
+             for r in shown]
+    columns = [("grad_norm", _num_cell("global_grad_norm")),
+               ("upd_ratio", _num_cell("worst_update_ratio")),
+               ("params", lambda r: str(r.get("n_params") or "-")),
+               ("heads", lambda r: "-" if r.get("heads_finite") is None
+                else ("ok" if all(r["heads_finite"]) else "NONFINITE"))]
+    lt.render_ledger(table, columns, out=out, title=title,
+                     name_header="sampled update")
+    if last and len(rows) > last:
+        out.write("  ... %d earlier sampled update(s) (--last %d)\n"
+                  % (len(rows) - last, last))
+    bad = summary["bad_updates"]
+    if bad:
+        out.write("Non-finite sampled update(s): %s\n"
+                  % ", ".join(str(u) for u in bad))
+        for r in rows:
+            if r["nonfinite_params"]:
+                out.write("  update %s bad grads: %s\n"
+                          % (r["update"],
+                             ", ".join(r["nonfinite_params"])))
+    prov = summary.get("provenance")
+    if prov:
+        out.write("Non-finite provenance (%s params):\n"
+                  % prov.get("params_state", "?"))
+        if prov.get("verdict"):
+            out.write("  VERDICT: %s\n" % prov["verdict"])
+        fb = prov.get("first_bad_op")
+        if fb:
+            out.write("  first bad op: %s (%s) output %s, kind %s%s\n"
+                      % (fb.get("op"), fb.get("op_type"),
+                         fb.get("output"), fb.get("kind"),
+                         ", stage %s" % fb["stage"]
+                         if fb.get("stage") is not None else ""))
+        for b in prov.get("bad_inputs") or []:
+            out.write("  bad input: %s %s (%s)\n"
+                      % (b.get("input"), b.get("name"), b.get("kind")))
+        if prov.get("error"):
+            out.write("  replay error: %s\n" % prov["error"])
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path",
+                    help="diagnostics bundle or numerics section (JSON)")
+    ap.add_argument("--last", type=int, default=None,
+                    help="show only the N most recent sampled updates")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON document")
+    args = ap.parse_args(argv)
+    try:
+        num = load_numerics(args.path)
+    except (OSError, ValueError) as e:
+        sys.stderr.write("numerics_report: %s\n" % e)
+        return 1
+    summary = summarize(num)
+    if args.json:
+        json.dump(summary, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        return 0
+    render(summary, last=args.last)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -72,7 +72,11 @@ _DOWN_HINTS = ("loss", "entropy", "err", "perplexity", "mae", "mse",
                # compile-time observability: cumulative XLA compile
                # seconds regress by going up — a cache-miss storm (or a
                # lost persistent-cache win) shows here
-               "compile_sec")
+               "compile_sec",
+               # numerics-monitor overhead: the sampled stats step's cost
+               # over the plain step regresses by going up
+               # (docs/observability.md "Numerics monitor")
+               "overhead")
 
 _EVENT_TYPES = ("scalar", "span", "counter", "gauge", "hist", "summary")
 
@@ -272,6 +276,28 @@ def _load_bench(run, doc, path):
         run.groups["cost"] = names
         if isinstance(cost.get("config"), dict):
             run.identity["cost"] = dict(cost["config"])
+    # num record (dryrun_multichip's numerics-monitor rung,
+    # MULTICHIP_NUM_*): numeric fields are gated headline metrics —
+    # num_grad_norm_rel_err (replicated-vs-ZeRO global gradient norm
+    # agreement) regresses by going UP (the "err" hint), and
+    # num_monitor_overhead (sampled stats step cost over the plain step)
+    # regresses by going UP (the "overhead" hint); the nested config
+    # block (device count / zero level / every_n) is IDENTITY — records
+    # stamped on different meshes or sampling cadences are different
+    # experiments, not a regression pair
+    num = rec.get("num") if isinstance(rec, dict) else None
+    if isinstance(num, dict):
+        names = set()
+        for k, v in num.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                run.bench[str(k)] = float(v)
+                names.add(str(k))
+        for name in run.bench:
+            if name.startswith("num_"):
+                names.add(name)
+        run.groups["num"] = names
+        if isinstance(num.get("config"), dict):
+            run.identity["num"] = dict(num["config"])
     chained = (run.meta or {}).get("telemetry_scalars")
     if chained:
         for candidate in (chained,

@@ -467,6 +467,12 @@ def step_anatomy(per_rank, ratio=STRAGGLER_RATIO):
         mfu = st.get("gauges", {}).get("mfu")
         if isinstance(mfu, (int, float)):
             row["mfu"] = float(mfu)
+        # the rank's last sampled global gradient norm (MXNET_MONITOR,
+        # mxnet_tpu/numerics.py): the training-dynamics column next to
+        # the efficiency one — absent when the monitor was off
+        gn = st.get("gauges", {}).get("grad_global_norm")
+        if isinstance(gn, (int, float)):
+            row["grad_norm"] = float(gn)
         table[rank] = row
     if not table:
         return {}
@@ -602,12 +608,16 @@ def render(agg, out=None):
     if anatomy:
         cols = anatomy["phases"]
         has_mfu = any("mfu" in rec for rec in anatomy["ranks"].values())
+        has_gn = any("grad_norm" in rec
+                     for rec in anatomy["ranks"].values())
         out.write("\nStep anatomy (per-rank mean, ms/step)\n")
         out.write("%6s %8s %10s" % ("rank", "steps", "step_ms"))
         for p in cols:
             out.write(" %10s" % p)
         if has_mfu:
             out.write(" %10s" % "mfu")
+        if has_gn:
+            out.write(" %10s" % "grad_norm")
         out.write("\n")
         for rank in sorted(anatomy["ranks"]):
             rec = anatomy["ranks"][rank]
@@ -618,6 +628,9 @@ def render(agg, out=None):
             if has_mfu:
                 out.write(" %10s" % ("%.4f" % rec["mfu"]
                                      if "mfu" in rec else "-"))
+            if has_gn:
+                out.write(" %10s" % ("%.4g" % rec["grad_norm"]
+                                     if "grad_norm" in rec else "-"))
             out.write("\n")
         verdict = "STRAGGLER" if anatomy["straggler"] is not None else "ok"
         out.write("  slowest rank: %s (%.2fx the median of the other "
